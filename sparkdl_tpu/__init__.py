@@ -1,0 +1,68 @@
+"""sparkdl_tpu — TPU-native Deep Learning Pipelines.
+
+A brand-new JAX/XLA framework with the capabilities of the reference
+``kailuowang/spark-deep-learning`` ("Deep Learning Pipelines"): image
+DataFrames, transfer learning via pretrained-CNN featurization, batch
+inference at scale, model deployment as vectorized UDFs, and distributed
+hyperparameter tuning — re-designed TPU-first (jit/shard_map over a device
+mesh instead of per-executor TF sessions; XLA collectives instead of
+Spark broadcast; Arrow batches instead of Spark partitions).
+
+Public surface mirrors the reference's ``python/sparkdl/__init__.py``.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Public API (lazy where the submodule pulls in heavyweight deps, so that
+# `import sparkdl_tpu` stays fast and works before jax initializes a device).
+_LAZY = {
+    # image / frame layer
+    "imageIO": "sparkdl_tpu.image",
+    "ImageSchema": "sparkdl_tpu.image",
+    "readImages": "sparkdl_tpu.image",
+    "DataFrame": "sparkdl_tpu.frame",
+    "Row": "sparkdl_tpu.frame",
+    # transformers
+    "DeepImageFeaturizer": "sparkdl_tpu.transformers.named_image",
+    "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
+    "TFImageTransformer": "sparkdl_tpu.transformers.named_image",
+    "KerasImageFileTransformer": "sparkdl_tpu.transformers.image_file",
+    "ImageFileTransformer": "sparkdl_tpu.transformers.image_file",
+    "KerasTransformer": "sparkdl_tpu.transformers.tensor",
+    "ModelTransformer": "sparkdl_tpu.transformers.tensor",
+    "TFTransformer": "sparkdl_tpu.transformers.tensor",
+    # graph layer
+    "ModelFunction": "sparkdl_tpu.graph.function",
+    "TFInputGraph": "sparkdl_tpu.graph.input",
+    "ModelInput": "sparkdl_tpu.graph.input",
+    # estimators / tuning
+    "KerasImageFileEstimator": "sparkdl_tpu.estimators.image_file_estimator",
+    "ImageFileEstimator": "sparkdl_tpu.estimators.image_file_estimator",
+    "ParamGridBuilder": "sparkdl_tpu.estimators.tuning",
+    "CrossValidator": "sparkdl_tpu.estimators.tuning",
+    # udf
+    "registerKerasImageUDF": "sparkdl_tpu.udf",
+    "register_image_udf": "sparkdl_tpu.udf",
+}
+
+__all__ = sorted(_LAZY) + ["__version__"]
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module 'sparkdl_tpu' has no attribute {name!r}")
+    import importlib
+
+    try:
+        mod = importlib.import_module(target)
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"sparkdl_tpu.{name} is declared in the public API but its "
+            f"module {target!r} is unavailable: {e}") from e
+    # "imageIO" exposes the module itself (parity with `from sparkdl import imageIO`)
+    obj = mod if name == "imageIO" else getattr(mod, name)
+    globals()[name] = obj
+    return obj

@@ -1,0 +1,187 @@
+"""A minimal columnar DataFrame over pyarrow.
+
+Design notes (TPU-first):
+  * Chunking is explicit: a frame is a ``pyarrow.Table`` whose record batches
+    play the role Spark partitions played in the reference — transformers
+    process the frame batch-wise and the inference engine re-buckets rows into
+    fixed device batch shapes (padding the tail) so XLA never recompiles.
+  * No lazy plan/optimizer: the reference's laziness came from Spark; here
+    stages run eagerly over Arrow batches, which keeps host->device pipelining
+    in our control (see sparkdl_tpu.parallel.engine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+class Row(dict):
+    """Dict-like row with attribute access (quacks like pyspark.sql.Row)."""
+
+    def __getattr__(self, item):
+        try:
+            return self[item]
+        except KeyError:
+            raise AttributeError(item)
+
+
+def _to_table(data) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, pa.RecordBatch):
+        return pa.Table.from_batches([data])
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(data, dict):
+        return pa.table(data)
+    if isinstance(data, list):  # list of dict rows
+        return pa.Table.from_pylist(data)
+    raise TypeError(f"Cannot build DataFrame from {type(data).__name__}")
+
+
+class DataFrame:
+    """Immutable columnar frame backed by a ``pyarrow.Table``."""
+
+    def __init__(self, data):
+        self._table = _to_table(data)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_pandas(pdf) -> "DataFrame":
+        return DataFrame(pa.Table.from_pandas(pdf, preserve_index=False))
+
+    @staticmethod
+    def from_rows(rows: List[dict], schema: Optional[pa.Schema] = None) -> "DataFrame":
+        if schema is not None:
+            return DataFrame(pa.Table.from_pylist(rows, schema=schema))
+        return DataFrame(pa.Table.from_pylist(rows))
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._table.column_names
+
+    def count(self) -> int:
+        return self._table.num_rows
+
+    def __len__(self) -> int:
+        return self._table.num_rows
+
+    def __repr__(self):
+        return f"DataFrame[{', '.join(f'{f.name}: {f.type}' for f in self.schema)}] ({len(self)} rows)"
+
+    # -- relational ops ----------------------------------------------------
+    def select(self, *cols: str) -> "DataFrame":
+        return DataFrame(self._table.select(list(cols)))
+
+    def drop(self, *cols: str) -> "DataFrame":
+        keep = [c for c in self.columns if c not in cols]
+        return DataFrame(self._table.select(keep))
+
+    def withColumn(self, name: str, values) -> "DataFrame":
+        """Append/replace a column.  ``values`` may be a pyarrow Array /
+        ChunkedArray, numpy array, or Python list."""
+        if isinstance(values, (pa.Array, pa.ChunkedArray)):
+            arr = values
+        elif isinstance(values, np.ndarray):
+            if values.ndim == 1:
+                arr = pa.array(values)
+            else:
+                # rank>1 numpy -> fixed-size-list-of-... column
+                arr = pa.array(list(values))
+        else:
+            arr = pa.array(values)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        t = self._table
+        if name in t.column_names:
+            # Replace in place, preserving schema position (pyspark semantics).
+            idx = t.column_names.index(name)
+            return DataFrame(t.set_column(idx, name, arr))
+        return DataFrame(t.append_column(name, arr))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        names = [new if c == old else c for c in self.columns]
+        return DataFrame(self._table.rename_columns(names))
+
+    def filter(self, mask) -> "DataFrame":
+        """Filter by boolean mask (numpy array / list / pyarrow bool array)."""
+        if isinstance(mask, (list, np.ndarray)):
+            mask = pa.array(np.asarray(mask, dtype=bool))
+        return DataFrame(self._table.filter(mask))
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._table.slice(0, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(pa.concat_tables([self._table, other._table],
+                                          promote_options="default"))
+
+    def repartition(self, n: int) -> "DataFrame":
+        """Re-chunk into ``n`` roughly equal record batches.  Partition-count
+        variation is the reference's stand-in for multi-node behavior in tests
+        (SURVEY.md §4) — preserved here for the same purpose."""
+        n = max(1, min(int(n), max(1, len(self))))
+        rows = len(self)
+        sizes = [rows // n + (1 if i < rows % n else 0) for i in range(n)]
+        combined = self._table.combine_chunks()
+        batches, off = [], 0
+        for s in sizes:
+            if s == 0:
+                continue
+            batches.append(combined.slice(off, s))
+            off += s
+        return DataFrame(pa.concat_tables(batches) if batches else combined)
+
+    @property
+    def num_partitions(self) -> int:
+        col0 = self._table.column(0) if self._table.num_columns else None
+        return col0.num_chunks if col0 is not None else 1
+
+    # -- materialization ---------------------------------------------------
+    def collect(self) -> List[Row]:
+        return [Row(r) for r in self._table.to_pylist()]
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def toPandas(self):
+        return self.to_pandas()
+
+    def column_to_numpy(self, name: str) -> np.ndarray:
+        """Materialize a column as numpy; list<float> columns stack to 2-D."""
+        col = self._table.column(name)
+        pytype = col.type
+        if pa.types.is_list(pytype) or pa.types.is_fixed_size_list(pytype):
+            return np.asarray(col.to_pylist(),
+                              dtype=pytype.value_type.to_pandas_dtype())
+        return col.to_numpy(zero_copy_only=False)
+
+    # -- batch protocol ----------------------------------------------------
+    def iter_batches(self, batch_size: Optional[int] = None) -> Iterator[pa.RecordBatch]:
+        """Iterate record batches; respects existing chunking unless a
+        ``batch_size`` re-slicing is requested."""
+        if batch_size is None:
+            yield from self._table.to_batches()
+        else:
+            yield from self._table.to_batches(max_chunksize=batch_size)
+
+    def map_rows(self, fn: Callable[[Row], dict]) -> "DataFrame":
+        """Row-wise map producing a new frame (host-side; used for cheap
+        struct manipulation like resize UDFs, never for model compute)."""
+        return DataFrame.from_rows([fn(r) for r in self.collect()])

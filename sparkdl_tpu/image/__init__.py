@@ -1,0 +1,43 @@
+"""Image schema + I/O (host side).
+
+TPU chips have no image-decode unit, so decode/resize stay on the host and
+feed the device pipeline — this package replaces the reference's
+``python/sparkdl/image/imageIO.py`` (and the Scala ``ImageUtils``) with a
+numpy/pyarrow/PIL implementation of the same OpenCV-convention image struct.
+"""
+
+from sparkdl_tpu.image.schema import (
+    ImageSchema,
+    imageSchema,
+    ocvTypes,
+    imageTypeByMode,
+    imageTypeByName,
+    imageArrayToStruct,
+    imageStructToArray,
+)
+from sparkdl_tpu.image.io import (
+    decodeImage,
+    resizeImage,
+    readImages,
+    readImagesWithCustomFn,
+    filesToDF,
+    createResizeImageUDF,
+    PIL_decode,
+)
+
+__all__ = [
+    "ImageSchema",
+    "imageSchema",
+    "ocvTypes",
+    "imageTypeByMode",
+    "imageTypeByName",
+    "imageArrayToStruct",
+    "imageStructToArray",
+    "decodeImage",
+    "resizeImage",
+    "readImages",
+    "readImagesWithCustomFn",
+    "filesToDF",
+    "createResizeImageUDF",
+    "PIL_decode",
+]

@@ -1,0 +1,170 @@
+"""Host-side image decode / resize / file ingestion.
+
+Replaces ``imageIO._decodeImage`` / ``readImagesWithCustomFn`` / ``filesToDF``
+/ ``createResizeImageUDF`` and the Scala ``ImageUtils.resizeImage``.  Decode
+runs on the host (PIL) because the TPU has no decode engine; the output of
+this layer is either image-struct rows (for the DataFrame API) or dense
+numpy batches (for the device pipeline).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.image.schema import (
+    imageArrayToStruct,
+    imageSchema,
+    imageStructToArray,
+)
+
+
+def PIL_decode(raw_bytes: bytes) -> Optional[np.ndarray]:
+    """Decode compressed image bytes to a [H,W,3] uint8 **BGR** array.
+
+    Counterpart of ``imageIO.PIL_decode``/``_decodeImage``: undecodable input
+    yields ``None`` (the reference drops/nulls such rows rather than failing
+    the job).
+    """
+    import io as _io
+
+    from PIL import Image
+
+    try:
+        img = Image.open(_io.BytesIO(raw_bytes))
+        img = img.convert("RGB")
+        rgb = np.asarray(img, dtype=np.uint8)
+    except Exception:
+        return None
+    return np.ascontiguousarray(rgb[:, :, ::-1])  # RGB -> BGR (OpenCV order)
+
+
+def decodeImage(raw_bytes: bytes, origin: str = "") -> Optional[dict]:
+    """Decode bytes into an image struct dict, or None on failure."""
+    arr = PIL_decode(raw_bytes)
+    if arr is None:
+        return None
+    return imageArrayToStruct(arr, origin=origin)
+
+
+def resizeImage(array: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize of a [H,W,C] uint8/float32 array on the host.
+
+    Counterpart of the Scala ``ImageUtils.resizeImage`` (java.awt bilinear) and
+    the TF resize the Python path used — parity is tolerance-based, matching
+    the reference's own tests (they assert closeness, not bit-equality, across
+    their two resize backends).
+    """
+    from PIL import Image
+
+    if array.shape[0] == height and array.shape[1] == width:
+        return array
+    dtype = array.dtype
+    if dtype == np.uint8:
+        img = Image.fromarray(array if array.shape[2] != 1 else array[:, :, 0])
+        out = np.asarray(img.resize((width, height), Image.BILINEAR), dtype=np.uint8)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out
+    # float path: resize channel-planes via PIL 'F' mode
+    planes = [
+        np.asarray(
+            Image.fromarray(array[:, :, c].astype(np.float32), mode="F")
+            .resize((width, height), Image.BILINEAR))
+        for c in range(array.shape[2])
+    ]
+    return np.stack(planes, axis=2).astype(dtype)
+
+
+def createResizeImageUDF(size: Sequence[int]) -> Callable[[dict], dict]:
+    """Return a row-level function image-struct -> resized image-struct.
+
+    Counterpart of ``imageIO.createResizeImageUDF``; with our DataFrame layer
+    it is applied via ``DataFrame.withColumn(map_struct=...)`` and, when a real
+    pyspark is present, can be wrapped with ``pyspark.sql.functions.udf``.
+    """
+    if len(size) != 2:
+        raise ValueError(f"New image size should have format [height, width], got {size}")
+    height, width = int(size[0]), int(size[1])
+
+    def _resize(row: Optional[dict]) -> Optional[dict]:
+        if row is None:
+            return None
+        arr = imageStructToArray(row)
+        out = resizeImage(arr, height, width)
+        return imageArrayToStruct(out, origin=row.get("origin", ""))
+
+    return _resize
+
+
+def _list_files(path: str, recursive: bool = False) -> List[str]:
+    """Expand a path/glob/directory into a sorted file list (deterministic
+    ordering replaces Spark's nondeterministic partition enumeration)."""
+    if os.path.isdir(path):
+        pattern = os.path.join(path, "**" if recursive else "*")
+        files = [f for f in _glob.glob(pattern, recursive=recursive)
+                 if os.path.isfile(f)]
+    else:
+        files = [f for f in _glob.glob(path, recursive=recursive)
+                 if os.path.isfile(f)]
+    return sorted(files)
+
+
+def filesToDF(path: str, numPartitions: Optional[int] = None,
+              recursive: bool = False):
+    """Read raw files into a DataFrame ``{filePath: str, fileData: binary}``.
+
+    Counterpart of ``imageIO.filesToDF`` (which wraps ``sc.binaryFiles``).
+    ``numPartitions`` controls batch chunking of the resulting frame.
+    """
+    from sparkdl_tpu.frame import DataFrame
+
+    files = _list_files(path, recursive=recursive)
+    rows = []
+    for f in files:
+        with open(f, "rb") as fh:
+            rows.append({"filePath": f, "fileData": fh.read()})
+    table = pa.table({
+        "filePath": pa.array([r["filePath"] for r in rows], type=pa.string()),
+        "fileData": pa.array([r["fileData"] for r in rows], type=pa.binary()),
+    })
+    df = DataFrame(table)
+    if numPartitions:
+        df = df.repartition(numPartitions)
+    return df
+
+
+def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.ndarray]],
+                           numPartitions: Optional[int] = None,
+                           recursive: bool = False):
+    """Read images under ``path`` using a custom decoder into an image-struct
+    DataFrame.  Counterpart of ``imageIO.readImagesWithCustomFn``; rows whose
+    decode fails become null image structs (kept, so origins stay auditable)."""
+    from sparkdl_tpu.frame import DataFrame
+
+    files = _list_files(path, recursive=recursive)
+    structs = []
+    for f in files:
+        with open(f, "rb") as fh:
+            arr = decode_f(fh.read())
+        if arr is None:
+            structs.append(None)
+        elif isinstance(arr, dict):
+            structs.append(arr)
+        else:
+            structs.append(imageArrayToStruct(np.asarray(arr), origin=f))
+    table = pa.table({"image": pa.array(structs, type=imageSchema)})
+    df = DataFrame(table)
+    if numPartitions:
+        df = df.repartition(numPartitions)
+    return df
+
+
+def readImages(path: str, numPartitions: Optional[int] = None,
+               recursive: bool = False):
+    """Read images with the default PIL decoder (BGR uint8)."""
+    return readImagesWithCustomFn(path, PIL_decode, numPartitions, recursive)

@@ -1,0 +1,45 @@
+"""Typed, string-addressable parameter system.
+
+This is the framework's config system, replacing the reference's Spark ML
+Params layer (``python/sparkdl/param/__init__.py`` — ``keyword_only``, shared
+``Param`` definitions, ``SparkDLTypeConverters``).  Every pipeline stage
+(transformer / estimator) carries typed, validated, *string-addressable*
+params; string addressability is load-bearing — it is what makes
+``ParamGridBuilder`` / ``CrossValidator`` hyperparameter search work.
+
+Spark-independent: no pyspark import anywhere.
+"""
+
+from sparkdl_tpu.param.params import (
+    Param,
+    Params,
+    TypeConverters,
+    keyword_only,
+)
+from sparkdl_tpu.param.shared import (
+    HasInputCol,
+    HasOutputCol,
+    HasBatchSize,
+    HasModelName,
+    HasTopK,
+    HasLabelCol,
+    HasOutputMode,
+    CanLoadImage,
+)
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+
+__all__ = [
+    "Param",
+    "Params",
+    "TypeConverters",
+    "keyword_only",
+    "SparkDLTypeConverters",
+    "HasInputCol",
+    "HasOutputCol",
+    "HasBatchSize",
+    "HasModelName",
+    "HasTopK",
+    "HasLabelCol",
+    "HasOutputMode",
+    "CanLoadImage",
+]

@@ -1,0 +1,6 @@
+"""Utilities: logging, metrics, profiling."""
+
+from sparkdl_tpu.utils.logging import get_logger
+from sparkdl_tpu.utils.metrics import Metrics, StepTimer, throughput_counter
+
+__all__ = ["get_logger", "Metrics", "StepTimer", "throughput_counter"]

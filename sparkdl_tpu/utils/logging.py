@@ -1,0 +1,33 @@
+"""Structured logging for the framework.
+
+The reference only used ad-hoc ``logging`` warnings; SURVEY.md §5 flags
+observability as a gap to fill — this gives every subsystem a namespaced
+logger with one consistent format.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "%(asctime)s %(levelname)s sparkdl_tpu.%(name)s: %(message)s"
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("SPARKDL_TPU_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("sparkdl_tpu")
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    return logging.getLogger("sparkdl_tpu").getChild(name)

@@ -1,0 +1,75 @@
+"""Step timing + throughput metrics.
+
+SURVEY.md §5: the reference had no metrics at all (Spark UI only); the TPU
+build makes images/sec/chip a first-class counter since it is the baseline
+metric.  Timers bracket device work with ``jax.block_until_ready`` so async
+dispatch doesn't fake speedups.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Metrics:
+    """A tiny metrics registry: named counters + gauges + timing lists."""
+
+    counters: Dict[str, float] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    timings_s: Dict[str, List[float]] = field(default_factory=dict)
+
+    def incr(self, name: str, value: float = 1.0):
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float):
+        self.gauges[name] = value
+
+    def record_time(self, name: str, seconds: float):
+        self.timings_s.setdefault(name, []).append(seconds)
+
+    def summary(self) -> Dict[str, float]:
+        out = dict(self.counters)
+        out.update(self.gauges)
+        for k, v in self.timings_s.items():
+            if v:
+                out[f"{k}.mean_s"] = sum(v) / len(v)
+                out[f"{k}.total_s"] = sum(v)
+                out[f"{k}.count"] = len(v)
+        return out
+
+
+class StepTimer:
+    """Wall-clock timer that forces device completion before stopping."""
+
+    def __init__(self, metrics: Optional[Metrics] = None, name: str = "step"):
+        self.metrics = metrics
+        self.name = name
+        self.elapsed_s = 0.0
+
+    @contextlib.contextmanager
+    def time(self, block_on=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None:
+                import jax
+                jax.block_until_ready(block_on)
+            self.elapsed_s = time.perf_counter() - t0
+            if self.metrics is not None:
+                self.metrics.record_time(self.name, self.elapsed_s)
+
+
+def throughput_counter(num_items: int, seconds: float, num_devices: int = 1) -> Dict[str, float]:
+    """items/sec and items/sec/chip — the baseline metric shape."""
+    ips = num_items / seconds if seconds > 0 else float("inf")
+    return {
+        "items_per_sec": ips,
+        "items_per_sec_per_chip": ips / max(1, num_devices),
+        "seconds": seconds,
+        "num_items": float(num_items),
+    }

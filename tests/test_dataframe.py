@@ -1,0 +1,62 @@
+"""DataFrame layer tests."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.frame import DataFrame
+
+
+def _df():
+    return DataFrame({"a": [1, 2, 3, 4, 5], "b": ["x", "y", "z", "w", "v"]})
+
+
+def test_construction_paths():
+    import pandas as pd
+    assert DataFrame.from_pandas(pd.DataFrame({"a": [1]})).count() == 1
+    assert DataFrame([{"a": 1}, {"a": 2}]).count() == 2
+    assert DataFrame(pa.table({"a": [1]})).columns == ["a"]
+    with pytest.raises(TypeError):
+        DataFrame(42)
+
+
+def test_select_drop_rename():
+    df = _df()
+    assert df.select("a").columns == ["a"]
+    assert df.drop("a").columns == ["b"]
+    assert df.withColumnRenamed("a", "c").columns == ["c", "b"]
+
+
+def test_with_column_and_replace():
+    df = _df().withColumn("c", np.arange(5))
+    assert df.columns == ["a", "b", "c"]
+    df2 = df.withColumn("c", [9, 9, 9, 9, 9])
+    assert df2.collect()[0]["c"] == 9
+    # rank-2 numpy becomes a list column
+    df3 = df.withColumn("v", np.ones((5, 3), dtype=np.float32))
+    mat = df3.column_to_numpy("v")
+    assert mat.shape == (5, 3)
+
+
+def test_filter_limit_union():
+    df = _df()
+    assert df.filter(np.array([True, False, True, False, True])).count() == 3
+    assert df.limit(2).count() == 2
+    assert df.union(df).count() == 10
+
+
+def test_repartition_and_batches():
+    df = _df().repartition(3)
+    assert df.num_partitions == 3
+    sizes = [b.num_rows for b in df.iter_batches()]
+    assert sum(sizes) == 5 and len(sizes) == 3
+    resliced = [b.num_rows for b in df.iter_batches(batch_size=2)]
+    assert sum(resliced) == 5 and max(resliced) <= 2
+
+
+def test_rows_and_map_rows():
+    df = _df()
+    rows = df.collect()
+    assert rows[0].a == 1 and rows[0]["b"] == "x"
+    out = df.map_rows(lambda r: {"double": r.a * 2})
+    assert [r.double for r in out.collect()] == [2, 4, 6, 8, 10]

@@ -1,0 +1,105 @@
+"""Image schema & I/O tests — round-trip array<->struct, decode of real
+fixture images, malformed input handling (reference C2 test strategy)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.image import (
+    PIL_decode,
+    createResizeImageUDF,
+    filesToDF,
+    imageArrayToStruct,
+    imageStructToArray,
+    imageTypeByMode,
+    imageTypeByName,
+    ocvTypes,
+    readImages,
+    resizeImage,
+)
+
+
+def test_ocv_mode_table():
+    assert ocvTypes["CV_8UC3"] == 16
+    assert imageTypeByName("CV_8UC3").dtype == "uint8"
+    assert imageTypeByMode(21).name == "CV_32FC3"
+    with pytest.raises(ValueError):
+        imageTypeByMode(99)
+
+
+@pytest.mark.parametrize("dtype,channels", [("uint8", 1), ("uint8", 3),
+                                            ("uint8", 4), ("float32", 3)])
+def test_array_struct_roundtrip(rng, dtype, channels):
+    if dtype == "uint8":
+        arr = (rng.random((7, 5, channels)) * 255).astype(np.uint8)
+    else:
+        arr = rng.random((7, 5, channels)).astype(np.float32)
+    s = imageArrayToStruct(arr, origin="mem://x")
+    assert s["height"] == 7 and s["width"] == 5 and s["nChannels"] == channels
+    back = imageStructToArray(s)
+    np.testing.assert_array_equal(arr, back)
+
+
+def test_struct_validation():
+    arr = np.zeros((4, 4, 3), dtype=np.uint8)
+    s = imageArrayToStruct(arr)
+    s["nChannels"] = 4
+    with pytest.raises(ValueError):
+        imageStructToArray(s)
+
+
+def test_decode_real_jpeg_is_bgr(fixture_images):
+    with open(fixture_images["paths"][0], "rb") as f:
+        raw = f.read()
+    bgr = PIL_decode(raw)
+    assert bgr is not None and bgr.ndim == 3 and bgr.shape[2] == 3
+    from PIL import Image
+    rgb = np.asarray(Image.open(fixture_images["paths"][0]).convert("RGB"))
+    np.testing.assert_array_equal(bgr[:, :, ::-1], rgb)
+
+
+def test_decode_failure_returns_none(fixture_images):
+    with open(fixture_images["bad"], "rb") as f:
+        assert PIL_decode(f.read()) is None
+
+
+def test_read_images_dataframe(fixture_images):
+    df = readImages(fixture_images["dir"])
+    assert df.count() == 4  # 3 good + 1 bad (null row kept)
+    rows = df.collect()
+    nulls = [r for r in rows if r["image"] is None]
+    assert len(nulls) == 1
+    good = [r for r in rows if r["image"] is not None]
+    for r in good:
+        arr = imageStructToArray(r["image"])
+        assert arr.dtype == np.uint8 and arr.shape[2] == 3
+
+
+def test_files_to_df_and_partitions(fixture_images):
+    df = filesToDF(fixture_images["dir"], numPartitions=2)
+    assert df.count() == 4
+    assert set(df.columns) == {"filePath", "fileData"}
+    assert df.num_partitions == 2
+
+
+def test_resize_bilinear_parity_with_pil(rng):
+    arr = (rng.random((20, 30, 3)) * 255).astype(np.uint8)
+    out = resizeImage(arr, 10, 15)
+    assert out.shape == (10, 15, 3)
+    from PIL import Image
+    ref = np.asarray(Image.fromarray(arr).resize((15, 10), Image.BILINEAR))
+    np.testing.assert_array_equal(out, ref)
+    # float path stays close to the uint8 path (tolerance-based, like the
+    # reference's cross-backend resize tests)
+    outf = resizeImage(arr.astype(np.float32), 10, 15)
+    assert outf.dtype == np.float32
+    assert np.abs(outf - ref.astype(np.float32)).max() <= 1.0
+
+
+def test_resize_udf_on_struct(rng):
+    arr = (rng.random((8, 8, 3)) * 255).astype(np.uint8)
+    udf = createResizeImageUDF([4, 6])
+    out = udf(imageArrayToStruct(arr, origin="o"))
+    assert out["height"] == 4 and out["width"] == 6
+    assert udf(None) is None
+    with pytest.raises(ValueError):
+        createResizeImageUDF([1, 2, 3])

@@ -1,0 +1,102 @@
+"""Param system tests — the config-system contract (reference C16)."""
+
+import pytest
+
+from sparkdl_tpu.param import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+    SparkDLTypeConverters,
+    TypeConverters,
+    keyword_only,
+)
+
+
+class _Stage(HasInputCol, HasOutputCol):
+    threshold = Param("undefined", "threshold", "a float knob",
+                      typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, threshold=None):
+        super().__init__()
+        self._setDefault(threshold=0.5)
+        self._set(**self._input_kwargs)
+
+
+def test_keyword_only_rejects_positional():
+    with pytest.raises(TypeError):
+        _Stage("x")
+
+
+def test_defaults_and_overrides():
+    s = _Stage(inputCol="in")
+    assert s.getInputCol() == "in"
+    assert s.getOrDefault("threshold") == 0.5
+    s.set("threshold", 0.9)
+    assert s.getOrDefault(s.threshold) == 0.9
+    assert s.isSet("threshold") and s.hasDefault("threshold")
+
+
+def test_string_addressability_for_grids():
+    s = _Stage(inputCol="in")
+    p = s.getParam("threshold")
+    m = s.extractParamMap({p: 0.25})
+    assert m[p] == 0.25
+    with pytest.raises(ValueError):
+        s.getParam("nope")
+
+
+def test_type_converters_validate():
+    s = _Stage(inputCol="in")
+    with pytest.raises(TypeError):
+        s.set("threshold", "not a float")
+    with pytest.raises(TypeError):
+        s.set("inputCol", 42)
+    assert TypeConverters.toInt(3.0) == 3
+    with pytest.raises(TypeError):
+        TypeConverters.toInt(3.5)
+
+
+def test_instances_do_not_alias():
+    a = _Stage(inputCol="a")
+    b = _Stage(inputCol="b")
+    a.set("threshold", 0.1)
+    assert b.getOrDefault("threshold") == 0.5
+    assert a.uid != b.uid
+
+
+def test_copy_with_extra():
+    a = _Stage(inputCol="a")
+    c = a.copy({a.getParam("threshold"): 0.7})
+    assert c.getOrDefault("threshold") == 0.7
+    assert a.getOrDefault("threshold") == 0.5
+
+
+def test_supported_name_converter():
+    conv = SparkDLTypeConverters.supportedNameConverter(["InceptionV3", "ResNet50"])
+    assert conv("inceptionv3") == "InceptionV3"
+    with pytest.raises(TypeError):
+        conv("AlexNet")
+    with pytest.raises(TypeError):
+        conv(7)
+
+
+def test_optimizer_and_loss_converters():
+    import optax
+    opt = SparkDLTypeConverters.toOptimizer("adam")
+    assert callable(opt)
+    assert isinstance(opt(1e-3), optax.GradientTransformation)
+    got = SparkDLTypeConverters.toOptimizer(optax.sgd(0.1))
+    assert isinstance(got, optax.GradientTransformation)
+    with pytest.raises(TypeError):
+        SparkDLTypeConverters.toOptimizer("nonsense")
+    assert SparkDLTypeConverters.toLoss("mean_squared_error") == "mse"
+    with pytest.raises(TypeError):
+        SparkDLTypeConverters.toLoss("nonsense")
+
+
+def test_explain_params():
+    s = _Stage(inputCol="in")
+    text = s.explainParams()
+    assert "threshold" in text and "inputCol" in text
