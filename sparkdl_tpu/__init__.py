@@ -47,7 +47,21 @@ _LAZY = {
     "register_image_udf": "sparkdl_tpu.udf",
 }
 
-__all__ = sorted(_LAZY) + ["__version__"]
+# Only advertise names whose modules actually exist, so `import *` works at
+# every stage of the build-out (layers land incrementally).
+import importlib.util as _ilu
+
+
+def _module_exists(mod: str) -> bool:
+    try:
+        return _ilu.find_spec(mod) is not None
+    except ModuleNotFoundError:  # missing parent package
+        return False
+
+
+__all__ = sorted(
+    n for n, m in _LAZY.items() if _module_exists(m)
+) + ["__version__"]
 
 
 def __getattr__(name: str):

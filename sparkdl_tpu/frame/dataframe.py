@@ -163,9 +163,26 @@ class DataFrame:
     def toPandas(self):
         return self.to_pandas()
 
+    def dropna(self, *cols: str) -> "DataFrame":
+        """Drop rows that are null in any of ``cols`` (all columns if none
+        given).  Nulls arise by design — e.g. undecodable images become null
+        structs (see image.io.readImagesWithCustomFn)."""
+        import pyarrow.compute as pc
+
+        names = list(cols) if cols else self.columns
+        mask = None
+        for c in names:
+            valid = pc.is_valid(self._table.column(c).combine_chunks())
+            mask = valid if mask is None else pc.and_(mask, valid)
+        return DataFrame(self._table.filter(mask)) if mask is not None else self
+
     def column_to_numpy(self, name: str) -> np.ndarray:
         """Materialize a column as numpy; list<float> columns stack to 2-D."""
         col = self._table.column(name)
+        if col.null_count:
+            raise ValueError(
+                f"Column {name!r} contains {col.null_count} null(s); filter "
+                f"them first (e.g. df.dropna({name!r}))")
         pytype = col.type
         if pa.types.is_list(pytype) or pa.types.is_fixed_size_list(pytype):
             return np.asarray(col.to_pylist(),

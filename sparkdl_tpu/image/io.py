@@ -124,13 +124,13 @@ def filesToDF(path: str, numPartitions: Optional[int] = None,
     from sparkdl_tpu.frame import DataFrame
 
     files = _list_files(path, recursive=recursive)
-    rows = []
+    data = []
     for f in files:
         with open(f, "rb") as fh:
-            rows.append({"filePath": f, "fileData": fh.read()})
+            data.append(fh.read())
     table = pa.table({
-        "filePath": pa.array([r["filePath"] for r in rows], type=pa.string()),
-        "fileData": pa.array([r["fileData"] for r in rows], type=pa.binary()),
+        "filePath": pa.array(files, type=pa.string()),
+        "fileData": pa.array(data, type=pa.binary()),
     })
     df = DataFrame(table)
     if numPartitions:

@@ -1,0 +1,113 @@
+"""Shared flax.linen building blocks for the pretrained-CNN zoo.
+
+The zoo replaces the reference's model registry (``python/sparkdl/transformers/
+named_image.py — SUPPORTED_MODELS`` and the Scala ``Models.scala`` packaged
+GraphDefs) with hand-written flax modules.  Design rules:
+
+  * NHWC layout, ``padding="SAME"`` via lax's TF-compatible asymmetric padding
+    — both match what the MXU/XLA:TPU pipeline expects and what the Keras
+    weights were trained under, so weight import is layout-transpose-free.
+  * Submodule names equal the corresponding Keras layer names wherever
+    keras.applications assigns explicit names (VGG/ResNet/Xception), so the
+    weight importer can match by name; InceptionV3 (auto-named layers
+    upstream) is matched by deterministic build order instead.
+  * BatchNorm carries real ``batch_stats`` so the same module trains (for
+    fine-tuning in the estimator) and infers (featurizer/predictor).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Keras BatchNormalization defaults; individual models override epsilon.
+BN_EPS_DEFAULT = 1e-3
+BN_MOMENTUM_DEFAULT = 0.99
+
+
+class SeparableConv2D(nn.Module):
+    """Depthwise-separable conv matching ``keras.layers.SeparableConv2D``.
+
+    Param layout mirrors Keras: ``depthwise_kernel`` [H,W,Cin,mult] and
+    ``pointwise_kernel`` [1,1,Cin*mult,Cout] (plus optional bias), so the
+    importer can copy Keras weights verbatim.  Lowered as a grouped conv
+    (feature_group_count=Cin) followed by a 1x1 conv — XLA fuses both onto
+    the MXU.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    depth_multiplier: int = 1
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cin = x.shape[-1]
+        kh, kw = self.kernel_size
+        dw = self.param(
+            "depthwise_kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.depth_multiplier))
+        pw = self.param(
+            "pointwise_kernel",
+            nn.initializers.lecun_normal(),
+            (1, 1, cin * self.depth_multiplier, self.features))
+        # Keras depthwise output channel (c, m) -> c*mult + m equals a C-major
+        # reshape, which is exactly lax's grouped-conv kernel layout.
+        dw_lax = dw.reshape(kh, kw, 1, cin * self.depth_multiplier)
+        dtype = self.dtype or x.dtype
+        y = jnp.asarray(x, dtype)
+        import jax.lax as lax
+
+        y = lax.conv_general_dilated(
+            y, jnp.asarray(dw_lax, dtype),
+            window_strides=self.strides,
+            padding=self.padding,
+            feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = lax.conv_general_dilated(
+            y, jnp.asarray(pw, dtype),
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (self.features,))
+            y = y + jnp.asarray(b, dtype)
+        return y
+
+
+class ConvBN(nn.Module):
+    """``conv2d_bn`` from keras.applications.inception_v3: Conv(no bias) +
+    BatchNorm(scale=False) + ReLU."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    bn_eps: float = BN_EPS_DEFAULT
+    bn_scale: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(self.features, self.kernel_size, strides=self.strides,
+                    padding=self.padding, use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train,
+                         momentum=BN_MOMENTUM_DEFAULT, epsilon=self.bn_eps,
+                         use_scale=self.bn_scale, name="bn")(x)
+        return nn.relu(x)
+
+
+def max_pool_valid(x: jnp.ndarray, window: int, stride: int) -> jnp.ndarray:
+    return nn.max_pool(x, (window, window), strides=(stride, stride),
+                       padding="VALID")
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    """GlobalAveragePooling2D — the featurizer cut of every non-VGG zoo
+    model (DeepImageFeaturizer's penultimate-layer semantics)."""
+    return jnp.mean(x, axis=(1, 2))

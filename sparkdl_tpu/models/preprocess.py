@@ -1,0 +1,70 @@
+"""ImageNet preprocessing as pure JAX functions, fused into the jit.
+
+Replaces the preprocessing the reference splices in front of zoo models as TF
+subgraphs (``python/sparkdl/graph/pieces.py — buildSpImageConverter`` plus the
+per-model ``keras.applications.*.preprocess_input`` nodes composed in
+``python/sparkdl/transformers/named_image.py — _buildTFGraphForName``).
+
+TPU-first design: the host pipeline ships **uint8 RGB** batches (4x less
+host->device traffic than float32); scaling / mean subtraction / channel
+reordering happen on-device inside the same XLA program as the conv stack, so
+they fuse with the first convolution's input handling and cost ~nothing.
+
+Semantics match ``keras.applications.imagenet_utils.preprocess_input`` modes:
+  * ``tf``     : x/127.5 - 1, RGB order          (InceptionV3, Xception, MobileNetV2)
+  * ``caffe``  : RGB->BGR, subtract BGR ImageNet means, no scaling
+                 (VGG16, VGG19, ResNet50)
+  * ``torch``  : x/255 then per-channel ImageNet mean/std normalize, RGB
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# ImageNet channel statistics (identical constants to keras.applications).
+_CAFFE_MEAN_BGR = (103.939, 116.779, 123.68)
+_TORCH_MEAN_RGB = (0.485, 0.456, 0.406)
+_TORCH_STD_RGB = (0.229, 0.224, 0.225)
+
+PREPROCESS_MODES = ("tf", "caffe", "torch", "none")
+
+
+def preprocess_tf(x: jnp.ndarray) -> jnp.ndarray:
+    """[0,255] RGB -> [-1, 1]."""
+    x = x.astype(jnp.float32)
+    return x / 127.5 - 1.0
+
+
+def preprocess_caffe(x: jnp.ndarray) -> jnp.ndarray:
+    """[0,255] RGB -> zero-centered BGR (no scaling)."""
+    x = x.astype(jnp.float32)
+    x = x[..., ::-1]  # RGB -> BGR
+    return x - jnp.asarray(_CAFFE_MEAN_BGR, dtype=jnp.float32)
+
+
+def preprocess_torch(x: jnp.ndarray) -> jnp.ndarray:
+    """[0,255] RGB -> normalized by ImageNet mean/std."""
+    x = x.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(_TORCH_MEAN_RGB, dtype=jnp.float32)
+    std = jnp.asarray(_TORCH_STD_RGB, dtype=jnp.float32)
+    return (x - mean) / std
+
+
+def preprocess_none(x: jnp.ndarray) -> jnp.ndarray:
+    return x.astype(jnp.float32)
+
+
+_MODES = {
+    "tf": preprocess_tf,
+    "caffe": preprocess_caffe,
+    "torch": preprocess_torch,
+    "none": preprocess_none,
+}
+
+
+def get_preprocess_fn(mode: str):
+    try:
+        return _MODES[mode]
+    except KeyError:
+        raise ValueError(
+            f"Unknown preprocess mode {mode!r}; supported: {PREPROCESS_MODES}")

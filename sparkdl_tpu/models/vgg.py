@@ -1,0 +1,62 @@
+"""VGG16 / VGG19 as flax modules.
+
+Zoo entries from the reference's ``SUPPORTED_MODELS`` registry
+(``python/sparkdl/transformers/named_image.py``; Scala twin in
+``src/main/scala/com/databricks/sparkdl/Models.scala``).  The reference's
+``DeepImageFeaturizer`` cuts VGG at the penultimate fully-connected layer
+(``fc2``, 4096-d) — exposed here via ``features=True``.
+
+Submodule names match keras.applications.vgg16/vgg19 layer names exactly
+("block1_conv1", ..., "fc1", "fc2", "predictions"), so the importer matches
+weights by name.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import max_pool_valid
+
+# convs per block: VGG16 = [2,2,3,3,3], VGG19 = [2,2,4,4,4]
+_VGG16_BLOCKS: Tuple[int, ...] = (2, 2, 3, 3, 3)
+_VGG19_BLOCKS: Tuple[int, ...] = (2, 2, 4, 4, 4)
+_BLOCK_FILTERS: Tuple[int, ...] = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    """Shared VGG backbone + classifier head."""
+
+    blocks: Tuple[int, ...]
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 features: bool = False, logits: bool = False) -> jnp.ndarray:
+        del train  # no BatchNorm / dropout-at-inference in classic VGG
+        for b, (n_convs, filters) in enumerate(zip(self.blocks, _BLOCK_FILTERS), 1):
+            for c in range(1, n_convs + 1):
+                x = nn.Conv(filters, (3, 3), padding="SAME",
+                            name=f"block{b}_conv{c}")(x)
+                x = nn.relu(x)
+            x = max_pool_valid(x, 2, 2)
+        # Flatten in Keras' channel-last row-major order.
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, name="fc2")(x))
+        if features:
+            return x  # 4096-d penultimate activations (featurizer cut)
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        if logits:
+            return x
+        return nn.softmax(x)
+
+
+def VGG16(num_classes: int = 1000) -> VGG:
+    return VGG(blocks=_VGG16_BLOCKS, num_classes=num_classes)
+
+
+def VGG19(num_classes: int = 1000) -> VGG:
+    return VGG(blocks=_VGG19_BLOCKS, num_classes=num_classes)

@@ -1,0 +1,101 @@
+"""Xception as a flax module.
+
+Zoo entry from the reference's ``SUPPORTED_MODELS`` registry
+(``python/sparkdl/transformers/named_image.py``).  Featurizer cut = global
+average pool (2048-d).
+
+Layer names mirror keras.applications.xception ("block1_conv1",
+"block2_sepconv1", ..., "predictions"); the four residual-shortcut convs/BNs
+are auto-named upstream, so they import by creation order — see
+``xception_auto_order`` and ``models/keras_import.py``.  Separable convs are
+bias-free depthwise+pointwise pairs lowered as grouped convs (MXU-friendly);
+BN epsilon is the Keras default 1e-3.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import SeparableConv2D, global_avg_pool
+
+# (block index, filters) of the three entry-flow residual blocks.
+_ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))
+
+
+def xception_auto_order():
+    """Creation-order import targets for the auto-named shortcut layers."""
+    order = []
+    for i, _ in _ENTRY_BLOCKS:
+        order.append(("conv", (f"shortcut{i}_conv",)))
+        order.append(("bn", (f"shortcut{i}_bn",)))
+    order.append(("conv", ("shortcut13_conv",)))
+    order.append(("bn", ("shortcut13_bn",)))
+    return order
+
+
+class Xception(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 features: bool = False, logits: bool = False) -> jnp.ndarray:
+
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                                epsilon=1e-3, name=name)
+
+        def sep(x, filters, name):
+            x = SeparableConv2D(filters, (3, 3), use_bias=False, name=name)(x)
+            return bn(f"{name}_bn")(x)
+
+        # Entry flow: two plain convs (VALID, stride-2 first)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
+                    use_bias=False, name="block1_conv1")(x)
+        x = nn.relu(bn("block1_conv1_bn")(x))
+        x = nn.Conv(64, (3, 3), padding="VALID", use_bias=False,
+                    name="block1_conv2")(x)
+        x = nn.relu(bn("block1_conv2_bn")(x))
+
+        # Entry-flow residual blocks (block2 has no leading relu — upstream
+        # quirk preserved)
+        for i, f in _ENTRY_BLOCKS:
+            residual = nn.Conv(f, (1, 1), strides=(2, 2), padding="SAME",
+                               use_bias=False, name=f"shortcut{i}_conv")(x)
+            residual = bn(f"shortcut{i}_bn")(residual)
+            if i > 2:
+                x = nn.relu(x)
+            x = sep(x, f, f"block{i}_sepconv1")
+            x = nn.relu(x)
+            x = sep(x, f, f"block{i}_sepconv2")
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = x + residual
+
+        # Middle flow: 8 identity blocks of three sepconvs
+        for i in range(5, 13):
+            residual = x
+            for j in (1, 2, 3):
+                x = nn.relu(x)
+                x = sep(x, 728, f"block{i}_sepconv{j}")
+            x = x + residual
+
+        # Exit flow
+        residual = nn.Conv(1024, (1, 1), strides=(2, 2), padding="SAME",
+                           use_bias=False, name="shortcut13_conv")(x)
+        residual = bn("shortcut13_bn")(residual)
+        x = nn.relu(x)
+        x = sep(x, 728, "block13_sepconv1")
+        x = nn.relu(x)
+        x = sep(x, 1024, "block13_sepconv2")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = x + residual
+
+        x = nn.relu(sep(x, 1536, "block14_sepconv1"))
+        x = nn.relu(sep(x, 2048, "block14_sepconv2"))
+        x = global_avg_pool(x)  # 2048-d featurizer cut
+        if features:
+            return x
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        if logits:
+            return x
+        return nn.softmax(x)

@@ -19,6 +19,10 @@ def _configure_root():
     if _configured:
         return
     level = os.environ.get("SPARKDL_TPU_LOG_LEVEL", "INFO").upper()
+    if level not in logging.getLevelNamesMapping():
+        logging.getLogger("sparkdl_tpu").warning(
+            "Invalid SPARKDL_TPU_LOG_LEVEL=%r; using INFO", level)
+        level = "INFO"
     handler = logging.StreamHandler()
     handler.setFormatter(logging.Formatter(_FORMAT))
     root = logging.getLogger("sparkdl_tpu")
@@ -30,4 +34,8 @@ def _configure_root():
 
 def get_logger(name: str) -> logging.Logger:
     _configure_root()
-    return logging.getLogger("sparkdl_tpu").getChild(name)
+    # Callers pass __name__, which already starts with the package prefix.
+    if name.startswith("sparkdl_tpu"):
+        name = name[len("sparkdl_tpu"):].lstrip(".")
+    root = logging.getLogger("sparkdl_tpu")
+    return root.getChild(name) if name else root

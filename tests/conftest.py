@@ -8,8 +8,8 @@ analog of the reference's `local[*]` SparkSession with multiple partitions.
 
 import os
 
-# Must be set before jax (or anything importing jax) initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU backend initializes (XLA_FLAGS is read from the
+# environment at client-creation time).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +18,14 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("KERAS_BACKEND", "tensorflow")
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+# The environment may pre-import jax with an accelerator platform pinned
+# (e.g. the axon TPU plugin registers via sitecustomize and freezes
+# JAX_PLATFORMS at import).  jax.config.update overrides that reliably;
+# plain env vars do not.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
