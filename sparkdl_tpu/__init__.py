@@ -48,15 +48,19 @@ _LAZY = {
 }
 
 # Only advertise names whose modules actually exist, so `import *` works at
-# every stage of the build-out (layers land incrementally).
-import importlib.util as _ilu
+# every stage of the build-out (layers land incrementally).  Existence is
+# checked on the filesystem, NOT via find_spec: find_spec imports parent
+# packages, which would defeat the lazy-import design above.
+import os as _os
+
+_PKG_DIR = _os.path.dirname(__file__)
 
 
 def _module_exists(mod: str) -> bool:
-    try:
-        return _ilu.find_spec(mod) is not None
-    except ModuleNotFoundError:  # missing parent package
-        return False
+    rel = mod.split(".")[1:]  # drop leading "sparkdl_tpu"
+    base = _os.path.join(_PKG_DIR, *rel)
+    return _os.path.isfile(base + ".py") or _os.path.isfile(
+        _os.path.join(base, "__init__.py"))
 
 
 __all__ = sorted(

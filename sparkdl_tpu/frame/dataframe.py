@@ -172,7 +172,7 @@ class DataFrame:
         names = list(cols) if cols else self.columns
         mask = None
         for c in names:
-            valid = pc.is_valid(self._table.column(c).combine_chunks())
+            valid = pc.is_valid(self._table.column(c))
             mask = valid if mask is None else pc.and_(mask, valid)
         return DataFrame(self._table.filter(mask)) if mask is not None else self
 

@@ -1,0 +1,13 @@
+"""Model-graph layer.
+
+Replaces the reference's TF-graph management layer (SURVEY.md §1 L2:
+``python/sparkdl/graph/`` — ``IsolatedSession``, ``GraphFunction``,
+``TFInputGraph``, name utils).  JAX's functional model removes the
+global-graph/session problem ``IsolatedSession`` existed to solve; what
+survives is the *composable, serializable unit of computation* —
+:class:`ModelFunction` — and the legacy-format importers.
+"""
+
+from sparkdl_tpu.graph.function import ModelFunction
+
+__all__ = ["ModelFunction"]

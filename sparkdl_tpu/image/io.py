@@ -101,6 +101,56 @@ def createResizeImageUDF(size: Sequence[int]) -> Callable[[dict], dict]:
     return _resize
 
 
+def structToModelInput(struct: dict, height: int, width: int) -> np.ndarray:
+    """Image struct -> [h,w,3] uint8 **RGB** array resized for a model.
+
+    Handles channel normalization the way the reference's converter subgraph
+    did (``graph/pieces.py — buildSpImageConverter`` BGR->RGB swap):
+    grayscale replicates to 3 channels, BGRA drops alpha, BGR flips to RGB.
+    """
+    arr = imageStructToArray(struct)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    c = arr.shape[2]
+    if c == 1:
+        arr = np.repeat(arr, 3, axis=2)
+    elif c == 4:
+        arr = arr[:, :, :3]          # BGRA -> BGR
+    arr = resizeImage(arr, height, width)
+    return arr[:, :, ::-1]           # BGR -> RGB
+
+
+_IO_EXECUTOR = None
+
+
+def _io_executor():
+    """Shared host-prep thread pool — reused across batches (spawning a pool
+    per device batch would put thread startup on the feed-the-chip path)."""
+    global _IO_EXECUTOR
+    if _IO_EXECUTOR is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _IO_EXECUTOR = ThreadPoolExecutor(
+            min(16, (os.cpu_count() or 4)), thread_name_prefix="sparkdl-io")
+    return _IO_EXECUTOR
+
+
+def structsToBatch(structs: Sequence[dict], height: int, width: int,
+                   num_threads: Optional[int] = None) -> np.ndarray:
+    """Decode+resize a sequence of image structs into one [N,h,w,3] uint8
+    RGB batch.  Threaded: PIL releases the GIL during resize, and host-side
+    prep is the throughput-critical path feeding the chip (SURVEY.md §7
+    hard part #2)."""
+    if len(structs) == 0:
+        return np.zeros((0, height, width, 3), dtype=np.uint8)
+    if (num_threads is not None and num_threads <= 1) or len(structs) < 4:
+        arrs = [structToModelInput(s, height, width) for s in structs]
+    else:
+        arrs = list(_io_executor().map(
+            lambda s: structToModelInput(s, height, width), structs))
+    return np.stack(arrs, axis=0)
+
+
 def _list_files(path: str, recursive: bool = False) -> List[str]:
     """Expand a path/glob/directory into a sorted file list (deterministic
     ordering replaces Spark's nondeterministic partition enumeration)."""

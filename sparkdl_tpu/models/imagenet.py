@@ -1,0 +1,70 @@
+"""ImageNet top-K prediction decoding.
+
+Counterpart of the reference's ``_decodeOutputAsPredictions``
+(``python/sparkdl/transformers/named_image.py``), which delegated to
+``keras.decode_predictions``.  We do the same when the ImageNet class-index
+file is available (cached or downloadable), and degrade to stable synthetic
+ids (``class_123``) in air-gapped environments instead of failing the job.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CLASS_INDEX = None          # idx -> (synset_id, description)
+_CLASS_INDEX_TRIED = False
+
+
+def _load_class_index():
+    global _CLASS_INDEX, _CLASS_INDEX_TRIED
+    if _CLASS_INDEX_TRIED:
+        return _CLASS_INDEX
+    _CLASS_INDEX_TRIED = True
+    try:
+        import json
+
+        from keras.utils import get_file
+
+        path = get_file(
+            "imagenet_class_index.json",
+            "https://storage.googleapis.com/download.tensorflow.org/"
+            "data/imagenet_class_index.json",
+            cache_subdir="models")
+        with open(path) as f:
+            raw = json.load(f)
+        _CLASS_INDEX = {int(k): (v[0], v[1]) for k, v in raw.items()}
+    except Exception as e:
+        logger.warning(
+            "ImageNet class index unavailable (%s); topK decode will use "
+            "synthetic class ids", e)
+        _CLASS_INDEX = None
+    return _CLASS_INDEX
+
+
+def decode_predictions(probs: np.ndarray, top: int = 5
+                       ) -> List[List[Tuple[str, str, float]]]:
+    """[(class_id, description, probability) x top] per row, sorted
+    descending — same row shape as keras ``decode_predictions``."""
+    probs = np.asarray(probs)
+    if probs.ndim != 2:
+        raise ValueError(f"Expected [batch, classes] probabilities, got "
+                         f"shape {probs.shape}")
+    index = _load_class_index()
+    out = []
+    for row in probs:
+        top_idx = np.argsort(row)[::-1][:top]
+        decoded = []
+        for i in top_idx:
+            if index is not None and int(i) in index:
+                cid, desc = index[int(i)]
+            else:
+                cid = desc = f"class_{int(i)}"
+            decoded.append((cid, desc, float(row[i])))
+        out.append(decoded)
+    return out

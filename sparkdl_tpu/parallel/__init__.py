@@ -1,0 +1,19 @@
+"""Device-mesh parallel execution layer.
+
+Replaces the reference's L0 execution engines (Spark task dispatch +
+TensorFrames JNI + per-partition ``tf.Session`` — SURVEY.md §1 L0, §3 hot
+loops) with XLA:TPU: a ``jax.sharding.Mesh`` over chips, jit-compiled
+programs with batch-axis ``NamedSharding``, and XLA collectives over ICI
+instead of Spark shuffle/broadcast.
+"""
+
+from sparkdl_tpu.parallel.mesh import (batch_sharding, get_mesh,
+                                       replicated_sharding)
+from sparkdl_tpu.parallel.engine import InferenceEngine
+
+__all__ = [
+    "InferenceEngine",
+    "batch_sharding",
+    "get_mesh",
+    "replicated_sharding",
+]

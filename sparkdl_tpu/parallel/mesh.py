@@ -1,0 +1,66 @@
+"""Mesh construction + sharding helpers.
+
+The TPU analog of the reference's cluster topology: where Spark mapped
+DataFrame partitions onto executor JVMs (SURVEY.md §2 "parallelism-strategy
+inventory"), we map batch rows onto chips through a ``jax.sharding.Mesh``.
+Axis names:
+
+  * ``data``  — batch-parallel axis (inference + gradient data parallelism).
+    ICI collectives (psum for gradients) ride this axis.
+  * ``model`` — reserved for tensor-parallel sharding of oversized heads;
+    size 1 for every model in the zoo (<=25M params need no TP).
+
+Multi-host note: ``get_mesh`` uses ``jax.devices()`` which spans all hosts
+under multi-controller jax.distributed initialization, so the same code
+scales from 1 chip to a pod slice; per-host data feeding belongs to the IO
+layer (``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def get_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
+             devices: Optional[Sequence] = None):
+    """Build a (data, model) mesh over the available chips.
+
+    ``num_devices`` limits the mesh to the first N devices (useful for
+    carving a tuning fan-out into independent slices); default = all.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"Requested {num_devices} devices; only {len(devs)} present")
+        devs = devs[:num_devices]
+    n = len(devs)
+    if n % model_parallel:
+        raise ValueError(
+            f"model_parallel={model_parallel} does not divide {n} devices")
+    grid = np.asarray(devs).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh, ndim: int = 1):
+    """NamedSharding that splits axis 0 (the batch) across the data axis and
+    replicates everything else."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh):
+    """NamedSharding that replicates (model params on every chip — the TPU
+    replacement for Spark's torrent-broadcast of the model GraphDef)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -1,0 +1,24 @@
+"""Pipeline-stage layer (transformers).
+
+Replaces the reference's L4 transformer surface
+(``python/sparkdl/transformers/`` + the Scala ``DeepImageFeaturizer`` —
+SURVEY.md §2 C3–C6, C13) with stages that run batched XLA programs on the
+device mesh instead of per-executor TF sessions.
+"""
+
+from sparkdl_tpu.transformers.base import (Estimator, Model, Pipeline,
+                                           PipelineModel, Transformer)
+from sparkdl_tpu.transformers.named_image import (DeepImageFeaturizer,
+                                                  DeepImagePredictor,
+                                                  TFImageTransformer)
+from sparkdl_tpu.transformers.tensor import (KerasTransformer,
+                                             ModelTransformer, TFTransformer)
+from sparkdl_tpu.transformers.image_file import (ImageFileTransformer,
+                                                 KerasImageFileTransformer)
+
+__all__ = [
+    "DeepImageFeaturizer", "DeepImagePredictor", "Estimator",
+    "ImageFileTransformer", "KerasImageFileTransformer", "KerasTransformer",
+    "Model", "ModelTransformer", "Pipeline", "PipelineModel",
+    "TFImageTransformer", "TFTransformer", "Transformer",
+]

@@ -1,0 +1,135 @@
+"""URI-column transformers with a user image loader.
+
+Replaces ``python/sparkdl/transformers/keras_image.py`` (C6
+``KerasImageFileTransformer`` + ``CanLoadImage`` mixin): the stage reads a
+column of file URIs, runs the user's ``imageLoader`` (decode + model-specific
+preprocessing, ``uri -> [H,W,C] float array``) on the host, and feeds the
+stacked batch to the model on the mesh.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.params import Param, keyword_only
+from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
+                                      HasOutputCol)
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.transformers.base import Transformer
+from sparkdl_tpu.transformers.tensor import _rows_to_list_array
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
+                           HasBatchSize, CanLoadImage):
+    """Apply a ModelFunction to images loaded from a URI column via the
+    user's ``imageLoader``.  Rows whose loader raises or returns None become
+    null outputs (the imageIO drop-to-null contract)."""
+
+    modelFunction = Param(
+        "undefined", "modelFunction",
+        "ModelFunction applied to the stacked loaded-image batch",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFunction=None,
+                 imageLoader=None,
+                 batchSize: Optional[int] = None):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFunction=None,
+                  imageLoader=None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+    def _load_images(self, uris: List[str]):
+        """Run the user loader over URIs (threaded — host decode is the
+        feed-the-chip bottleneck); returns (stacked batch, valid indices)."""
+        loader = self.getImageLoader()
+
+        def safe_load(uri):
+            if uri is None:
+                return None
+            try:
+                arr = loader(uri)
+                return None if arr is None else np.asarray(arr)
+            except Exception as e:
+                logger.warning("imageLoader failed for %r: %s", uri, e)
+                return None
+
+        with ThreadPoolExecutor(min(16, max(2, len(uris)))) as ex:
+            arrays = list(ex.map(safe_load, uris))
+        valid_idx = [i for i, a in enumerate(arrays) if a is not None]
+        if not valid_idx:
+            raise ValueError(
+                f"imageLoader produced no usable images out of {len(uris)} URIs")
+        batch = np.stack([arrays[i] for i in valid_idx]).astype(np.float32)
+        return batch, valid_idx
+
+    def _transform(self, dataset):
+        uris = dataset.table.column(self.getInputCol()).to_pylist()
+        batch, valid_idx = self._load_images(uris)
+        mf = self.getModelFunction()
+        eng = InferenceEngine(mf.fn, mf.variables,
+                              device_batch_size=self.getBatchSize())
+        out = np.asarray(eng(batch))
+        flat = out.reshape(out.shape[0], -1).astype(np.float32)
+        values: List[Optional[list]] = [None] * len(uris)
+        for row, i in zip(flat, valid_idx):
+            values[i] = [float(v) for v in row]
+        return dataset.withColumn(
+            self.getOutputCol(), pa.array(values, type=pa.list_(pa.float32())))
+
+
+class KerasImageFileTransformer(ImageFileTransformer):
+    """The Keras-model flavor: ``modelFile`` (.h5/.keras) is converted to a
+    ModelFunction on first use — reference's ``KerasImageFileTransformer``."""
+
+    modelFile = Param(
+        "undefined", "modelFile",
+        "path to a saved Keras model applied to the loaded images")
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFile: Optional[str] = None,
+                 imageLoader=None,
+                 batchSize: Optional[int] = None):
+        Transformer.__init__(self)
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFile: Optional[str] = None,
+                  imageLoader=None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def getModelFile(self):
+        return self.getOrDefault(self.modelFile)
+
+    def getModelFunction(self):
+        if not self.isSet(self.modelFunction):
+            from sparkdl_tpu.graph.function import ModelFunction
+
+            self._set(modelFunction=ModelFunction.from_keras(self.getModelFile()))
+        return self.getOrDefault(self.modelFunction)

@@ -1,0 +1,194 @@
+"""Tensor-column transformers.
+
+Replaces ``python/sparkdl/transformers/tf_tensor.py`` (C5 ``TFTransformer``)
+and ``keras_tensor.py`` (C6 ``KerasTransformer``): applying a model to
+numeric/array columns.  The reference froze a TF graph and ran it blockwise
+through TensorFrames; here the model is a :class:`ModelFunction` jitted over
+the mesh.
+
+  * :class:`ModelTransformer` — the native stage: ModelFunction over one
+    array column.
+  * :class:`KerasTransformer` — loads a user Keras model (file or object),
+    converts it to a ModelFunction (graph.keras_convert), then behaves like
+    ModelTransformer.  Input rows are 1-D float arrays (reference contract).
+  * :class:`TFTransformer` — multi-input/multi-output mapping form: a
+    TFInputGraph/ModelFunction plus {column->input} / {output->column}
+    maps (reference's feed/fetch wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.params import Param, keyword_only
+from sparkdl_tpu.param.shared import HasBatchSize, HasInputCol, HasOutputCol
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.transformers.base import Transformer
+
+
+def _rows_to_list_array(mat: np.ndarray) -> pa.Array:
+    mat = np.asarray(mat)
+    flat = mat.reshape(mat.shape[0], -1).astype(np.float32)
+    return pa.array([[float(v) for v in row] for row in flat],
+                    type=pa.list_(pa.float32()))
+
+
+class ModelTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+    """Apply a ModelFunction to an array column (one row = one example)."""
+
+    modelFunction = Param(
+        "undefined", "modelFunction",
+        "ModelFunction applied to the stacked input column",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFunction=None,
+                 batchSize: Optional[int] = None):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFunction=None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+    def _transform(self, dataset):
+        x = dataset.column_to_numpy(self.getInputCol()).astype(np.float32)
+        mf = self.getModelFunction()
+        eng = InferenceEngine(mf.fn, mf.variables,
+                              device_batch_size=self.getBatchSize())
+        out = eng(x)
+        return dataset.withColumn(self.getOutputCol(), _rows_to_list_array(out))
+
+
+class KerasTransformer(ModelTransformer):
+    """Apply a user Keras model to a column of 1-D float arrays.
+
+    Counterpart of the reference's ``KerasTransformer``
+    (``keras_tensor.py``): ``modelFile`` points at a saved Keras model
+    (HDF5/.keras); it is converted once to a jax ModelFunction at first
+    transform.
+    """
+
+    modelFile = Param(
+        "undefined", "modelFile",
+        "path to a saved Keras model (.h5/.keras) applied row-wise")
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFile: Optional[str] = None,
+                 batchSize: Optional[int] = None):
+        # Note: bypasses ModelTransformer.__init__ (keyword_only stashing
+        # composes badly across two levels); Params init + own defaults.
+        Transformer.__init__(self)
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFile: Optional[str] = None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def getModelFile(self):
+        return self.getOrDefault(self.modelFile)
+
+    def getModelFunction(self):
+        if not self.isSet(self.modelFunction):
+            from sparkdl_tpu.graph.function import ModelFunction
+
+            mf = ModelFunction.from_keras(self.getModelFile())
+            self._set(modelFunction=mf)
+        return self.getOrDefault(self.modelFunction)
+
+
+class TFTransformer(Transformer, HasBatchSize):
+    """Mapping form: model with named inputs/outputs over several columns.
+
+    Counterpart of the reference's ``TFTransformer`` (C5): ``inputMapping``
+    = {column name -> model input name}, ``outputMapping`` = {model output
+    name -> new column name}.  The model is a :class:`ModelFunction` whose
+    ``fn(variables, x)`` takes a dict of arrays keyed by input name and
+    returns a dict keyed by output name (exactly what
+    ``TFInputGraph``-imported graphs produce).
+    """
+
+    modelFunction = Param(
+        "undefined", "modelFunction",
+        "ModelFunction taking/returning dicts keyed by input/output names",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    inputMapping = Param(
+        "undefined", "inputMapping", "{column -> model input name}",
+        typeConverter=SparkDLTypeConverters.toColumnToTensorMap)
+
+    outputMapping = Param(
+        "undefined", "outputMapping", "{model output name -> column}",
+        typeConverter=SparkDLTypeConverters.toColumnToTensorMap)
+
+    @keyword_only
+    def __init__(self, modelFunction=None,
+                 inputMapping: Optional[Dict[str, str]] = None,
+                 outputMapping: Optional[Dict[str, str]] = None,
+                 batchSize: Optional[int] = None):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, modelFunction=None,
+                  inputMapping: Optional[Dict[str, str]] = None,
+                  outputMapping: Optional[Dict[str, str]] = None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+    def getInputMapping(self) -> Dict[str, str]:
+        return self.getOrDefault(self.inputMapping)
+
+    def getOutputMapping(self) -> Dict[str, str]:
+        return self.getOrDefault(self.outputMapping)
+
+    def _transform(self, dataset):
+        mf = self.getModelFunction()
+        in_map = self.getInputMapping()
+        out_map = self.getOutputMapping()
+        missing = set(in_map.values()) - set(mf.input_names)
+        if missing:
+            raise ValueError(
+                f"inputMapping refers to unknown model inputs {sorted(missing)}; "
+                f"model has {list(mf.input_names)}")
+        missing = set(out_map) - set(mf.output_names)
+        if missing:
+            raise ValueError(
+                f"outputMapping refers to unknown model outputs "
+                f"{sorted(missing)}; model has {list(mf.output_names)}")
+        x = {
+            input_name: dataset.column_to_numpy(col).astype(np.float32)
+            for col, input_name in in_map.items()
+        }
+        eng = InferenceEngine(mf.fn, mf.variables,
+                              device_batch_size=self.getBatchSize())
+        out = eng(x)
+        if not isinstance(out, dict):
+            out = {mf.output_names[0]: out}
+        for output_name, col in out_map.items():
+            dataset = dataset.withColumn(
+                col, _rows_to_list_array(out[output_name]))
+        return dataset

@@ -10,7 +10,8 @@ from __future__ import annotations
 import logging
 import os
 
-_FORMAT = "%(asctime)s %(levelname)s sparkdl_tpu.%(name)s: %(message)s"
+# %(name)s is the full dotted logger name (already sparkdl_tpu-prefixed).
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _configured = False
 
 
@@ -19,7 +20,8 @@ def _configure_root():
     if _configured:
         return
     level = os.environ.get("SPARKDL_TPU_LOG_LEVEL", "INFO").upper()
-    if level not in logging.getLevelNamesMapping():
+    if level not in ("CRITICAL", "FATAL", "ERROR", "WARNING", "WARN", "INFO",
+                     "DEBUG", "NOTSET"):
         logging.getLogger("sparkdl_tpu").warning(
             "Invalid SPARKDL_TPU_LOG_LEVEL=%r; using INFO", level)
         level = "INFO"

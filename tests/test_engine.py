@@ -1,0 +1,131 @@
+"""Inference-engine tests on the 8-device virtual CPU mesh.
+
+The reference simulates multi-executor behavior with multiple local
+partitions (SURVEY.md §4); the TPU analog is a virtual 8-device CPU mesh
+(see conftest).  These tests assert the engine's fixed-shape padding, the
+sharded execution path, and the streaming window produce exactly the same
+numbers as a plain unsharded call.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.parallel import InferenceEngine, get_mesh
+from sparkdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _fn(variables, x):
+    # toy "model": affine + nonlinearity, batch on axis 0
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"] + variables["b"])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(3)
+    variables = {
+        "w": rng.normal(size=(12, 5)).astype(np.float32),
+        "b": rng.normal(size=(5,)).astype(np.float32),
+    }
+    x = rng.normal(size=(45, 12)).astype(np.float32)
+    ref = np.tanh(x @ variables["w"] + variables["b"])
+    return variables, x, ref
+
+
+def test_mesh_spans_all_devices():
+    import jax
+
+    mesh = get_mesh()
+    assert mesh.size == len(jax.devices()) == 8
+    assert mesh.shape[DATA_AXIS] == 8 and mesh.shape[MODEL_AXIS] == 1
+
+
+def test_mesh_subset_and_validation():
+    mesh = get_mesh(num_devices=4)
+    assert mesh.size == 4
+    with pytest.raises(ValueError, match="only"):
+        get_mesh(num_devices=99)
+    with pytest.raises(ValueError, match="does not divide"):
+        get_mesh(num_devices=4, model_parallel=3)
+
+
+def test_engine_matches_unsharded(setup):
+    variables, x, ref = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=16)
+    out = eng(x)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_rounds_batch_to_data_axis(setup):
+    variables, x, ref = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=10)
+    # 8-way data axis: 10 -> 16
+    assert eng.device_batch_size == 16
+    np.testing.assert_allclose(eng(x), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_ragged_tail_is_trimmed(setup):
+    variables, x, ref = setup
+    # 45 rows, batch 32 -> chunks of 32 and 13 (padded to 32, trimmed)
+    eng = InferenceEngine(_fn, variables, device_batch_size=32)
+    out = eng(x)
+    assert out.shape[0] == 45
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_streaming_window(setup):
+    variables, x, ref = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    batches = [x[:20], x[20:23], x[23:]]
+    outs = list(eng.map_batches(batches, window=2))
+    got = np.concatenate(outs, axis=0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_empty_input_rejected(setup):
+    variables, x, _ = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    with pytest.raises(ValueError, match="Empty"):
+        eng(x[:0])
+
+
+def test_engine_compute_dtype_bf16(setup):
+    import jax.numpy as jnp
+
+    variables, x, ref = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=16,
+                          compute_dtype=jnp.bfloat16)
+    out = np.asarray(eng(x), dtype=np.float32)
+    # bf16 has ~3 decimal digits; loose tolerance
+    np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
+
+
+def test_engine_pytree_output(setup):
+    variables, x, ref = setup
+
+    def fn2(v, x):
+        import jax.numpy as jnp
+
+        y = jnp.tanh(x @ v["w"] + v["b"])
+        return {"y": y, "norm": jnp.sum(y * y, axis=-1)}
+
+    eng = InferenceEngine(fn2, variables, device_batch_size=16)
+    out = eng(x)
+    np.testing.assert_allclose(out["y"], ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out["norm"], (ref * ref).sum(-1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_engine_output_is_actually_sharded(setup):
+    """The compiled call must shard the batch over the data axis (this is
+    the chips-get-rows contract, not just a numerical one)."""
+    import jax
+
+    variables, x, _ = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=16)
+    dev_out = eng.run_padded(np.zeros((16, 12), np.float32))
+    shards = dev_out.addressable_shards
+    assert len(shards) == 8
+    assert all(s.data.shape == (2, 5) for s in shards)
