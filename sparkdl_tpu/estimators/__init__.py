@@ -1,0 +1,29 @@
+"""Estimator layer (training + tuning).
+
+Replaces the reference's L5 (``python/sparkdl/estimators/`` — C15
+``KerasImageFileEstimator``) and the pyspark.ml tuning/evaluation machinery
+it plugged into (``CrossValidator``, ``ParamGridBuilder``, evaluators),
+re-built for the mesh: a single fit is data-parallel over every chip (XLA
+psum gradient all-reduce), and hyperparameter fan-out reuses one compiled
+step where shapes allow.
+"""
+
+from sparkdl_tpu.estimators.classification import (LogisticRegression,
+                                                   LogisticRegressionModel)
+from sparkdl_tpu.estimators.evaluation import (BinaryClassificationEvaluator,
+                                               Evaluator,
+                                               MulticlassClassificationEvaluator)
+from sparkdl_tpu.estimators.image_file_estimator import (ImageFileEstimator,
+                                                         ImageFileModel,
+                                                         KerasImageFileEstimator)
+from sparkdl_tpu.estimators.tuning import (CrossValidator, CrossValidatorModel,
+                                           ParamGridBuilder,
+                                           TrainValidationSplit)
+
+__all__ = [
+    "BinaryClassificationEvaluator", "CrossValidator", "CrossValidatorModel",
+    "Evaluator", "ImageFileEstimator", "ImageFileModel",
+    "KerasImageFileEstimator", "LogisticRegression",
+    "LogisticRegressionModel", "MulticlassClassificationEvaluator",
+    "ParamGridBuilder", "TrainValidationSplit",
+]

@@ -1,0 +1,161 @@
+"""Classifier heads for transfer learning.
+
+The reference's north-star recipe pairs ``DeepImageFeaturizer`` with a Spark
+ML classifier (``LogisticRegression`` in the README's flowers example —
+BASELINE.json config #1).  pyspark isn't a dependency here, so the framework
+ships its own mesh-trained logistic-regression head with the pyspark.ml
+column contract (featuresCol/labelCol/predictionCol/probabilityCol).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.shared import HasLabelCol
+from sparkdl_tpu.parallel import mesh as mesh_lib
+from sparkdl_tpu.parallel.train import fit_data_parallel
+from sparkdl_tpu.transformers.base import Estimator, Model
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _HasClassifierCols(HasLabelCol):
+    featuresCol = Param("undefined", "featuresCol",
+                        "input column of feature vectors",
+                        typeConverter=TypeConverters.toString)
+    predictionCol = Param("undefined", "predictionCol",
+                          "output column of predicted class indices",
+                          typeConverter=TypeConverters.toString)
+    probabilityCol = Param("undefined", "probabilityCol",
+                           "output column of class probabilities",
+                           typeConverter=TypeConverters.toString)
+
+    def getFeaturesCol(self):
+        return self.getOrDefault(self.featuresCol)
+
+    def getPredictionCol(self):
+        return self.getOrDefault(self.predictionCol)
+
+    def getProbabilityCol(self):
+        return self.getOrDefault(self.probabilityCol)
+
+
+class LogisticRegression(Estimator, _HasClassifierCols):
+    """Multinomial logistic regression trained data-parallel on the mesh."""
+
+    maxIter = Param("undefined", "maxIter", "training epochs",
+                    typeConverter=TypeConverters.toInt)
+    regParam = Param("undefined", "regParam", "L2 regularization strength",
+                     typeConverter=TypeConverters.toFloat)
+    learningRate = Param("undefined", "learningRate", "adam learning rate",
+                         typeConverter=TypeConverters.toFloat)
+    batchSize = Param("undefined", "batchSize", "global train batch size",
+                      typeConverter=TypeConverters.toInt)
+    seed = Param("undefined", "seed", "shuffle/init seed",
+                 typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, featuresCol: str = "features", labelCol: str = "label",
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability",
+                 maxIter: int = 50, regParam: float = 0.0,
+                 learningRate: float = 0.05, batchSize: int = 256,
+                 seed: int = 0):
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction",
+                         probabilityCol="probability", maxIter=50,
+                         regParam=0.0, learningRate=0.05, batchSize=256,
+                         seed=0)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, featuresCol: Optional[str] = None,
+                  labelCol: Optional[str] = None,
+                  predictionCol: Optional[str] = None,
+                  probabilityCol: Optional[str] = None,
+                  maxIter: Optional[int] = None,
+                  regParam: Optional[float] = None,
+                  learningRate: Optional[float] = None,
+                  batchSize: Optional[int] = None,
+                  seed: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    def _fit(self, dataset) -> "LogisticRegressionModel":
+        import jax.numpy as jnp
+        import optax
+
+        x = dataset.column_to_numpy(self.getFeaturesCol()).astype(np.float32)
+        y = np.asarray(dataset.column_to_numpy(self.getLabelCol()),
+                       dtype=np.int32)
+        if x.ndim != 2:
+            raise ValueError(f"featuresCol must hold vectors; got shape "
+                             f"{x.shape}")
+        num_classes = int(y.max()) + 1
+        rng = np.random.default_rng(self.getOrDefault(self.seed))
+        params = {
+            "w": (rng.normal(0, 0.01, (x.shape[1], num_classes))
+                  .astype(np.float32)),
+            "b": np.zeros((num_classes,), np.float32),
+        }
+        reg = self.getOrDefault(self.regParam)
+
+        def predict_fn(p, xb):
+            return jnp.asarray(xb) @ p["w"] + p["b"]  # logits
+
+        def ce_loss(logits, yb):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb.astype(jnp.int32))
+
+        # L2 as additive weight decay in the optimizer (keeps the loss
+        # per-example clean).
+        lr = self.getOrDefault(self.learningRate)
+        opt = (optax.chain(optax.add_decayed_weights(reg), optax.adam(lr))
+               if reg else optax.adam(lr))
+
+        fitted, losses = fit_data_parallel(
+            predict_fn, params, x, y,
+            optimizer=opt, loss=ce_loss,
+            batch_size=self.getOrDefault(self.batchSize),
+            epochs=self.getOrDefault(self.maxIter),
+            seed=self.getOrDefault(self.seed))
+        logger.info("LogisticRegression fit: %d classes, final loss %.4f",
+                    num_classes, losses[-1] if losses else float("nan"))
+        model = LogisticRegressionModel(weights=fitted,
+                                        numClasses=num_classes)
+        model._set(featuresCol=self.getFeaturesCol(),
+                   labelCol=self.getLabelCol(),
+                   predictionCol=self.getPredictionCol(),
+                   probabilityCol=self.getProbabilityCol())
+        return model
+
+
+class LogisticRegressionModel(Model, _HasClassifierCols):
+    """Fitted head: adds prediction + probability columns."""
+
+    def __init__(self, weights=None, numClasses: int = 0):
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction",
+                         probabilityCol="probability")
+        self.weights = weights
+        self.numClasses = numClasses
+
+    def _transform(self, dataset):
+        x = dataset.column_to_numpy(self.getFeaturesCol()).astype(np.float32)
+        logits = x @ self.weights["w"] + self.weights["b"]
+        z = logits - logits.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        pred = p.argmax(axis=1)
+        out = dataset.withColumn(
+            self.getPredictionCol(), pa.array(pred.astype(np.int64)))
+        return out.withColumn(
+            self.getProbabilityCol(),
+            pa.array([[float(v) for v in row] for row in p],
+                     type=pa.list_(pa.float32())))

@@ -1,0 +1,268 @@
+"""Image-file estimator: distributed transfer learning + tuning fan-out.
+
+Replaces ``python/sparkdl/estimators/keras_image_file_estimator.py`` (C15
+``KerasImageFileEstimator``) and upgrades its execution model (SURVEY.md
+§3.3):
+
+  reference: collect (uri,label) to driver -> driver-side PIL loop ->
+             sc.broadcast(numpy) -> ONE SPARK TASK PER PARAM MAP, each task
+             a single-process Keras fit.
+  here:      threaded host load ONCE -> each fit is DATA-PARALLEL over the
+             whole mesh (XLA psum gradient all-reduce — the new north-star
+             capability) -> param maps run sequentially against the same
+             in-memory arrays, reusing the compiled step when shapes and
+             optimizer topology allow (SURVEY.md §7 hard part #5).
+
+The user model is a :class:`ModelFunction` (or a Keras ``modelFile``
+converted on the fly).  BatchNorm statistics stay frozen during fine-tuning
+(inference-mode conversion) — weights still train; divergence from Keras
+``fit`` (which updates moving stats) is documented here deliberately.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
+                                      HasLabelCol, HasOutputCol)
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.train import fit_data_parallel
+from sparkdl_tpu.transformers.base import Estimator, Model
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
+                         HasBatchSize, CanLoadImage):
+    """Fine-tune a model on images loaded from a URI column.
+
+    Params mirror the reference's (``kerasOptimizer``/``kerasLoss``/
+    ``kerasFitParams`` become ``optimizer``/``loss``/``fitParams``; the
+    Keras-named aliases live on :class:`KerasImageFileEstimator`).
+    """
+
+    modelFunction = Param(
+        "undefined", "modelFunction",
+        "trainable ModelFunction (fn(variables, x) -> predictions)",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    optimizer = Param(
+        "undefined", "optimizer",
+        "optax optimizer, factory, or name (adam/sgd/rmsprop/...)",
+        typeConverter=SparkDLTypeConverters.toOptimizer)
+
+    loss = Param(
+        "undefined", "loss",
+        "loss name (categorical_crossentropy/...) or callable (pred, y)->[B]",
+        typeConverter=SparkDLTypeConverters.toLoss)
+
+    fitParams = Param(
+        "undefined", "fitParams",
+        "fit settings: {'epochs': int, 'shuffle': bool, 'seed': int}",
+        typeConverter=TypeConverters.toDict)
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 labelCol: Optional[str] = None,
+                 modelFunction=None,
+                 imageLoader=None,
+                 optimizer=None,
+                 loss: Optional[Any] = None,
+                 fitParams: Optional[Dict] = None,
+                 batchSize: Optional[int] = None):
+        super().__init__()
+        self._setDefault(batchSize=32, fitParams={},
+                         loss="categorical_crossentropy")
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  labelCol: Optional[str] = None,
+                  modelFunction=None,
+                  imageLoader=None,
+                  optimizer=None,
+                  loss: Optional[Any] = None,
+                  fitParams: Optional[Dict] = None,
+                  batchSize: Optional[int] = None):
+        return self._set(**self._input_kwargs)
+
+    # -- param access ------------------------------------------------------
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+    def getOptimizer(self):
+        if self.isDefined(self.optimizer) and self.isSet(self.optimizer):
+            return self.getOrDefault(self.optimizer)
+        return None
+
+    def getLoss(self):
+        return self.getOrDefault(self.loss)
+
+    def getFitParams(self) -> Dict:
+        return dict(self.getOrDefault(self.fitParams))
+
+    # -- validation (reference: _validateParams) ---------------------------
+    def _validateParams(self):
+        missing = []
+        for p in ("inputCol", "labelCol", "outputCol", "imageLoader"):
+            if not self.isDefined(self.getParam(p)) or not self.isSet(
+                    self.getParam(p)):
+                missing.append(p)
+        try:
+            self.getModelFunction()
+        except KeyError:
+            missing.append("modelFunction")
+        if missing:
+            raise ValueError(
+                f"{type(self).__name__} requires params {missing} to be set")
+        return True
+
+    # -- data loading (reference: _getNumpyFeaturesAndLabels) --------------
+    def _load_numpy(self, dataset) -> Tuple[np.ndarray, np.ndarray]:
+        uris = dataset.table.column(self.getInputCol()).to_pylist()
+        labels = dataset.table.column(self.getLabelCol()).to_pylist()
+        loader = self.getImageLoader()
+        with ThreadPoolExecutor(min(16, max(2, len(uris)))) as ex:
+            arrays = list(ex.map(lambda u: np.asarray(loader(u)), uris))
+        x = np.stack(arrays).astype(np.float32)
+        y = np.asarray(labels)
+        if y.dtype == object:  # one-hot rows as lists
+            y = np.asarray([np.asarray(v, dtype=np.float32) for v in labels])
+        return x, y
+
+    # -- fitting -----------------------------------------------------------
+    def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray) -> "ImageFileModel":
+        mf = self.getModelFunction()
+        fp = self.getFitParams()
+        fitted, losses = fit_data_parallel(
+            mf.fn, mf.variables, x, y,
+            optimizer=self.getOptimizer(),
+            loss=self.getLoss(),
+            batch_size=self.getBatchSize(),
+            epochs=int(fp.get("epochs", 1)),
+            shuffle=bool(fp.get("shuffle", True)),
+            seed=int(fp.get("seed", 0)))
+        from sparkdl_tpu.graph.function import ModelFunction
+
+        fitted_mf = ModelFunction(fn=mf.fn, variables=fitted,
+                                  input_names=mf.input_names,
+                                  output_names=mf.output_names)
+        model = ImageFileModel(modelFunction=fitted_mf,
+                               trainLosses=losses)
+        model._set(inputCol=self.getInputCol(),
+                   outputCol=self.getOutputCol(),
+                   imageLoader=self.getImageLoader(),
+                   batchSize=self.getBatchSize())
+        return model
+
+    def _fit(self, dataset) -> "ImageFileModel":
+        self._validateParams()
+        x, y = self._load_numpy(dataset)
+        return self._fit_on_arrays(x, y)
+
+    def fitMultiple(self, dataset, paramMaps):
+        """One model per param map.  Data is loaded ONCE (the analog of the
+        reference's single broadcast) and reused across maps."""
+        self._validateParams()
+        x, y = self._load_numpy(dataset)
+        for i, pm in enumerate(paramMaps):
+            est = self.copy(pm)
+            yield i, est._fit_on_arrays(x, y)
+
+
+class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
+                     CanLoadImage):
+    """Fitted model: applies the trained ModelFunction to images loaded from
+    the URI column (the role the returned ``KerasImageFileTransformer``
+    played in the reference)."""
+
+    modelFunction = Param(
+        "undefined", "modelFunction", "fitted ModelFunction",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    def __init__(self, modelFunction=None, trainLosses=None):
+        super().__init__()
+        self._setDefault(batchSize=32)
+        if modelFunction is not None:
+            self._set(modelFunction=modelFunction)
+        self.trainLosses = list(trainLosses or [])
+
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+    def _transform(self, dataset):
+        from sparkdl_tpu.transformers.image_file import ImageFileTransformer
+
+        t = ImageFileTransformer(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+            modelFunction=self.getModelFunction(),
+            imageLoader=self.getImageLoader(),
+            batchSize=self.getBatchSize())
+        return t.transform(dataset)
+
+
+class KerasImageFileEstimator(ImageFileEstimator):
+    """Reference-parity flavor: Keras param names + ``modelFile`` input
+    (``KerasImageFileEstimator(kerasOptimizer=..., kerasLoss=...,
+    kerasFitParams=..., modelFile=...)``)."""
+
+    modelFile = Param(
+        "undefined", "modelFile",
+        "path to a saved Keras model (.h5/.keras) to fine-tune")
+
+    @keyword_only
+    def __init__(self, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 labelCol: Optional[str] = None,
+                 modelFile: Optional[str] = None,
+                 imageLoader=None,
+                 kerasOptimizer=None,
+                 kerasLoss: Optional[Any] = None,
+                 kerasFitParams: Optional[Dict] = None,
+                 batchSize: Optional[int] = None):
+        Estimator.__init__(self)
+        self._setDefault(batchSize=32, fitParams={},
+                         loss="categorical_crossentropy")
+        kw = dict(self._input_kwargs)
+        # Map keras-named params onto the native ones.
+        if kw.get("kerasOptimizer") is not None:
+            kw["optimizer"] = kw.pop("kerasOptimizer")
+        else:
+            kw.pop("kerasOptimizer", None)
+        if kw.get("kerasLoss") is not None:
+            kw["loss"] = kw.pop("kerasLoss")
+        else:
+            kw.pop("kerasLoss", None)
+        if kw.get("kerasFitParams") is not None:
+            kw["fitParams"] = kw.pop("kerasFitParams")
+        else:
+            kw.pop("kerasFitParams", None)
+        self._set(**kw)
+
+    def getModelFile(self):
+        return self.getOrDefault(self.modelFile)
+
+    def getModelFunction(self):
+        if not self.isSet(self.modelFunction):
+            from sparkdl_tpu.graph.function import ModelFunction
+
+            self._set(modelFunction=ModelFunction.from_keras(
+                self.getModelFile()))
+        return self.getOrDefault(self.modelFunction)
+
+    def _validateParams(self):
+        if not self.isSet(self.modelFunction) and not self.isSet(
+                self.getParam("modelFile")):
+            raise ValueError(
+                "KerasImageFileEstimator requires modelFile (or "
+                "modelFunction) to be set")
+        return super()._validateParams()

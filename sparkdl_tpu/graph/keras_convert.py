@@ -206,15 +206,20 @@ def _convert_node(layer, p, xs):
     if t == "AveragePooling2D":
         return _pool2d(layer, xs, "avg")
     if t == "GlobalAveragePooling2D":
-        return jnp.mean(xs[0], axis=(1, 2))
+        return jnp.mean(xs[0], axis=(1, 2),
+                        keepdims=getattr(layer, "keepdims", False))
     if t == "GlobalMaxPooling2D":
-        return jnp.max(xs[0], axis=(1, 2))
+        return jnp.max(xs[0], axis=(1, 2),
+                       keepdims=getattr(layer, "keepdims", False))
     if t == "Activation":
         return _activation_fn(layer.activation)(xs[0])
     if t == "ReLU":
-        import jax
-
-        y = jax.nn.relu(xs[0])
+        # Full keras semantics: f(x) = max_value-clipped relu above
+        # threshold, negative_slope below it.
+        x = xs[0]
+        thr = float(getattr(layer, "threshold", 0.0) or 0.0)
+        slope = float(getattr(layer, "negative_slope", 0.0) or 0.0)
+        y = jnp.where(x >= thr, x, slope * (x - thr))
         if layer.max_value is not None:
             y = jnp.minimum(y, layer.max_value)
         return y
@@ -264,6 +269,17 @@ def _convert_node(layer, p, xs):
         f"the jax converter yet")
 
 
+# every layer type _convert_node can lower (InputLayer is skipped upstream)
+_SUPPORTED_TYPES = frozenset({
+    "Conv2D", "DepthwiseConv2D", "SeparableConv2D", "Dense",
+    "BatchNormalization", "MaxPooling2D", "AveragePooling2D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling2D", "Activation", "ReLU",
+    "LeakyReLU", "Softmax", "Flatten", "Reshape", "Permute", "Dropout",
+    "GaussianNoise", "GaussianDropout", "SpatialDropout2D",
+    "ActivityRegularization", "Add", "Subtract", "Multiply", "Average",
+    "Maximum", "Concatenate", "ZeroPadding2D", "UpSampling2D", "Rescaling",
+})
+
 # layer types whose weights we collect, keyed by their keras weight names
 _PARAM_NAMES = {
     "Conv2D": ("kernel", "bias"),
@@ -309,6 +325,17 @@ def keras_to_model_function(model_or_path, *, jit: bool = False) -> ModelFunctio
         else:
             raise ValueError(
                 "Model has no functional graph; call it on a batch first")
+
+    # Validate the whole graph eagerly: unsupported layers must fail at
+    # conversion, not deep inside a later jit trace.
+    unsupported = sorted({
+        f"{type(layer).__name__}({layer.name})"
+        for layer in model.layers
+        if type(layer).__name__ not in _SUPPORTED_TYPES
+        and type(layer).__name__ != "InputLayer"})
+    if unsupported:
+        raise NotImplementedError(
+            f"Keras layers not supported by the jax converter: {unsupported}")
 
     # Collect weights once: {layer_name: {weight_name: array}}
     params: Dict[str, Dict[str, np.ndarray]] = {}

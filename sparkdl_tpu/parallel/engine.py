@@ -194,3 +194,25 @@ class InferenceEngine:
     @property
     def num_devices(self) -> int:
         return self.mesh.size
+
+
+def get_cached_engine(holder, model_function, *, device_batch_size: int,
+                      **engine_kwargs) -> InferenceEngine:
+    """Engine cache keyed on (model_function, batch) living on ``holder``
+    (typically a pipeline stage): repeated ``transform`` calls — e.g. a
+    CrossValidator loop — reuse one compiled program and one device copy of
+    the weights instead of recompiling per call.
+
+    The cache entry pins the ModelFunction alive so id-keying cannot alias
+    a recycled object.
+    """
+    cache = holder.__dict__.setdefault("_engine_cache", {})
+    key = (id(model_function), device_batch_size)
+    entry = cache.get(key)
+    if entry is None:
+        eng = InferenceEngine(model_function.fn, model_function.variables,
+                              device_batch_size=device_batch_size,
+                              **engine_kwargs)
+        cache[key] = (model_function, eng)
+        return eng
+    return entry[1]

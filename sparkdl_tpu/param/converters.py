@@ -48,20 +48,41 @@ class SparkDLTypeConverters:
         if isinstance(value, optax.GradientTransformation):
             return value
         if callable(value):
+            # Must be a ZERO-ARG factory (called at fit time).  Reject
+            # constructors like optax.adam here so the mistake surfaces at
+            # set time, not mid-fit.
+            import inspect
+
+            try:
+                sig = inspect.signature(value)
+                required = [
+                    p for p in sig.parameters.values()
+                    if p.default is inspect.Parameter.empty
+                    and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            except (TypeError, ValueError):
+                required = []
+            if required:
+                raise TypeError(
+                    f"Optimizer factory {value!r} requires arguments "
+                    f"{[p.name for p in required]}; pass a constructed "
+                    f"optimizer (e.g. optax.adam(1e-3)) or a zero-arg factory")
             return value
         if isinstance(value, str):
             name = value.lower()
+            # Name strings construct with keras-style default learning rates
+            # (the reference's string->keras-optimizer contract); pass an
+            # optax object for custom settings.
             table = {
-                "adam": optax.adam,
-                "adamw": optax.adamw,
-                "sgd": optax.sgd,
-                "rmsprop": optax.rmsprop,
-                "adagrad": optax.adagrad,
-                "lamb": optax.lamb,
-                "lion": optax.lion,
+                "adam": lambda: optax.adam(1e-3),
+                "adamw": lambda: optax.adamw(1e-3),
+                "sgd": lambda: optax.sgd(1e-2),
+                "rmsprop": lambda: optax.rmsprop(1e-3),
+                "adagrad": lambda: optax.adagrad(1e-2),
+                "lamb": lambda: optax.lamb(1e-3),
+                "lion": lambda: optax.lion(1e-4),
             }
             if name in table:
-                return table[name]
+                return table[name]()
             raise TypeError(f"Unknown optimizer name {value!r}")
         raise TypeError(f"Could not convert {value!r} to an optimizer")
 

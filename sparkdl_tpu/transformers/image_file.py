@@ -9,19 +9,18 @@ stacked batch to the model on the mesh.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 import numpy as np
 import pyarrow as pa
 
+from sparkdl_tpu.image.io import _io_executor
 from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, keyword_only
 from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
                                       HasOutputCol)
-from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.engine import get_cached_engine
 from sparkdl_tpu.transformers.base import Transformer
-from sparkdl_tpu.transformers.tensor import _rows_to_list_array
 from sparkdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -60,8 +59,10 @@ class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         return self.getOrDefault(self.modelFunction)
 
     def _load_images(self, uris: List[str]):
-        """Run the user loader over URIs (threaded — host decode is the
-        feed-the-chip bottleneck); returns (stacked batch, valid indices)."""
+        """Run the user loader over URIs (on the shared host-IO pool — host
+        decode is the feed-the-chip bottleneck); returns (stacked batch,
+        valid indices).  All-failed input yields an empty batch (all-null
+        output), per the drop-to-null contract."""
         loader = self.getImageLoader()
 
         def safe_load(uri):
@@ -74,26 +75,27 @@ class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                 logger.warning("imageLoader failed for %r: %s", uri, e)
                 return None
 
-        with ThreadPoolExecutor(min(16, max(2, len(uris)))) as ex:
-            arrays = list(ex.map(safe_load, uris))
+        arrays = list(_io_executor().map(safe_load, uris))
         valid_idx = [i for i, a in enumerate(arrays) if a is not None]
         if not valid_idx:
-            raise ValueError(
-                f"imageLoader produced no usable images out of {len(uris)} URIs")
+            logger.warning("imageLoader produced no usable images out of %d "
+                           "URIs; output column is all null", len(uris))
+            return np.zeros((0,), np.float32), valid_idx
         batch = np.stack([arrays[i] for i in valid_idx]).astype(np.float32)
         return batch, valid_idx
 
     def _transform(self, dataset):
         uris = dataset.table.column(self.getInputCol()).to_pylist()
         batch, valid_idx = self._load_images(uris)
-        mf = self.getModelFunction()
-        eng = InferenceEngine(mf.fn, mf.variables,
-                              device_batch_size=self.getBatchSize())
-        out = np.asarray(eng(batch))
-        flat = out.reshape(out.shape[0], -1).astype(np.float32)
         values: List[Optional[list]] = [None] * len(uris)
-        for row, i in zip(flat, valid_idx):
-            values[i] = [float(v) for v in row]
+        if valid_idx:
+            mf = self.getModelFunction()
+            eng = get_cached_engine(self, mf,
+                                    device_batch_size=self.getBatchSize())
+            out = np.asarray(eng(batch))
+            flat = out.reshape(out.shape[0], -1).astype(np.float32)
+            for row, i in zip(flat, valid_idx):
+                values[i] = [float(v) for v in row]
         return dataset.withColumn(
             self.getOutputCol(), pa.array(values, type=pa.list_(pa.float32())))
 

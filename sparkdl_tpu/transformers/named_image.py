@@ -27,7 +27,7 @@ from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
 from sparkdl_tpu.param.shared import (HasBatchSize, HasInputCol, HasModelName,
                                       HasOutputCol, HasOutputMode, HasTopK)
-from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.engine import InferenceEngine, get_cached_engine
 from sparkdl_tpu.transformers.base import Transformer
 from sparkdl_tpu.utils.logging import get_logger
 
@@ -270,8 +270,7 @@ class TFImageTransformer(_ImageInputStage, HasOutputMode):
             h, w = int(first["height"]), int(first["width"])
         batch = self._batch_for(structs, valid_idx, h, w)
         mf = self.getModelFunction()
-        eng = InferenceEngine(mf.fn, mf.variables,
-                              device_batch_size=self.getBatchSize())
+        eng = get_cached_engine(self, mf, device_batch_size=self.getBatchSize())
         out = np.asarray(eng(batch))
         n = len(structs)
         mode = self.getOutputMode()
@@ -279,7 +278,7 @@ class TFImageTransformer(_ImageInputStage, HasOutputMode):
             flat = out.reshape(out.shape[0], -1).astype(np.float32)
             return dataset.withColumn(
                 self.getOutputCol(), _float_list_array(flat, valid_idx, n))
-        # image mode: each output row must be [H,W,C]
+        # image mode: each output row must be [B,H,W,C]
         if out.ndim != 4:
             raise ValueError(
                 f'outputMode="image" needs [B,H,W,C] model output, got '
@@ -287,6 +286,8 @@ class TFImageTransformer(_ImageInputStage, HasOutputMode):
         values: List[Optional[dict]] = [None] * n
         for row, i in zip(out, valid_idx):
             origin = structs[i].get("origin", "") if structs[i] else ""
+            if row.shape[-1] in (3, 4):
+                row = row[:, :, ::-1]  # model RGB -> struct BGR convention
             values[i] = imageArrayToStruct(
                 np.ascontiguousarray(row, dtype=np.float32), origin=origin)
         return dataset.withColumn(

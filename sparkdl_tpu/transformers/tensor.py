@@ -26,7 +26,7 @@ import pyarrow as pa
 from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, keyword_only
 from sparkdl_tpu.param.shared import HasBatchSize, HasInputCol, HasOutputCol
-from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.engine import get_cached_engine
 from sparkdl_tpu.transformers.base import Transformer
 
 
@@ -67,8 +67,7 @@ class ModelTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
     def _transform(self, dataset):
         x = dataset.column_to_numpy(self.getInputCol()).astype(np.float32)
         mf = self.getModelFunction()
-        eng = InferenceEngine(mf.fn, mf.variables,
-                              device_batch_size=self.getBatchSize())
+        eng = get_cached_engine(self, mf, device_batch_size=self.getBatchSize())
         out = eng(x)
         return dataset.withColumn(self.getOutputCol(), _rows_to_list_array(out))
 
@@ -183,8 +182,7 @@ class TFTransformer(Transformer, HasBatchSize):
             input_name: dataset.column_to_numpy(col).astype(np.float32)
             for col, input_name in in_map.items()
         }
-        eng = InferenceEngine(mf.fn, mf.variables,
-                              device_batch_size=self.getBatchSize())
+        eng = get_cached_engine(self, mf, device_batch_size=self.getBatchSize())
         out = eng(x)
         if not isinstance(out, dict):
             out = {mf.output_names[0]: out}

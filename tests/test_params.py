@@ -84,11 +84,14 @@ def test_supported_name_converter():
 
 def test_optimizer_and_loss_converters():
     import optax
+    # Name strings construct ready-to-use optimizers with default LRs.
     opt = SparkDLTypeConverters.toOptimizer("adam")
-    assert callable(opt)
-    assert isinstance(opt(1e-3), optax.GradientTransformation)
+    assert isinstance(opt, optax.GradientTransformation)
     got = SparkDLTypeConverters.toOptimizer(optax.sgd(0.1))
     assert isinstance(got, optax.GradientTransformation)
+    # Zero-arg factories pass through for fit-time construction.
+    factory = SparkDLTypeConverters.toOptimizer(lambda: optax.adam(2e-3))
+    assert callable(factory)
     with pytest.raises(TypeError):
         SparkDLTypeConverters.toOptimizer("nonsense")
     assert SparkDLTypeConverters.toLoss("mean_squared_error") == "mse"
