@@ -1,0 +1,225 @@
+"""TFInputGraph: uniform import of legacy TF model formats.
+
+Counterpart of ``python/sparkdl/graph/input.py`` (C9): the same six factory
+constructors over live graphs, GraphDefs, TF ``Saver`` checkpoints and
+SavedModels (with or without signature_defs), producing one canonical form.
+The reference froze to a GraphDef and shipped it to executor sessions; here
+the frozen GraphDef is compiled to a jax :class:`ModelFunction`
+(graph.tf_import) so legacy models run on the TPU mesh like native ones.
+
+The TF 2.x CPU runtime is used ONLY at import time (reading checkpoints,
+freezing variables); it never touches the execution path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.graph.tf_import import graphdef_to_jax
+from sparkdl_tpu.graph.utils import op_name, tensor_name
+
+
+def _tf():
+    import os
+
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    import tensorflow as tf
+
+    return tf
+
+
+@dataclass
+class TFInputGraph:
+    """A frozen GraphDef + feed/fetch naming, ready to compile to jax.
+
+    ``input_mapping``/``output_mapping`` translate signature keys (or raw
+    names) to graph tensor names — the role of the reference's
+    feed/fetch-mapping builders.
+    """
+
+    graph_def: object
+    input_mapping: Dict[str, str]    # logical name -> graph tensor name
+    output_mapping: Dict[str, str]   # graph tensor name -> logical name
+    _model_function: Optional[ModelFunction] = field(default=None, repr=False)
+
+    # -- canonical consumption --------------------------------------------
+    @property
+    def input_names(self) -> List[str]:
+        return list(self.input_mapping)
+
+    @property
+    def output_names(self) -> List[str]:
+        return list(self.output_mapping.values())
+
+    def model_function(self) -> ModelFunction:
+        """Compile (once) to a jax ModelFunction keyed by LOGICAL names."""
+        if self._model_function is None:
+            feeds = list(self.input_mapping.values())
+            fetches = list(self.output_mapping)
+            raw = graphdef_to_jax(self.graph_def, feeds, fetches)
+            logical_in = {v: k for k, v in self.input_mapping.items()}
+            out_map = dict(self.output_mapping)
+
+            def fn(variables, x):
+                if isinstance(x, dict):
+                    x = {self.input_mapping.get(k, k): v
+                         for k, v in x.items()}
+                y = raw.fn(variables, x)
+                if isinstance(y, dict):
+                    return {out_map.get(k, k): v for k, v in y.items()}
+                return y
+
+            self._model_function = ModelFunction(
+                fn=fn, variables=raw.variables,
+                input_names=tuple(logical_in[f] for f in feeds),
+                output_names=tuple(out_map[f] for f in fetches))
+        return self._model_function
+
+    # -- constructors (the reference's six) --------------------------------
+    @classmethod
+    def fromGraph(cls, graph, sess, feed_names: Sequence[str],
+                  fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a live tf.compat.v1 Graph + Session (variables frozen)."""
+        frozen = _freeze(sess, graph.as_graph_def(add_shapes=True),
+                         fetch_names)
+        return cls(
+            graph_def=frozen,
+            input_mapping={n: tensor_name(n) for n in feed_names},
+            output_mapping={tensor_name(n): n for n in fetch_names})
+
+    @classmethod
+    def fromGraphDef(cls, graph_def, feed_names: Sequence[str],
+                     fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From an already-frozen GraphDef."""
+        return cls(
+            graph_def=graph_def,
+            input_mapping={n: tensor_name(n) for n in feed_names},
+            output_mapping={tensor_name(n): n for n in fetch_names})
+
+    @classmethod
+    def fromCheckpoint(cls, checkpoint_dir: str, feed_names: Sequence[str],
+                       fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a TF Saver checkpoint directory (latest checkpoint +
+        ``.meta`` graph)."""
+        graph_def, _ = _load_checkpoint(checkpoint_dir, fetch_names)
+        return cls(
+            graph_def=graph_def,
+            input_mapping={n: tensor_name(n) for n in feed_names},
+            output_mapping={tensor_name(n): n for n in fetch_names})
+
+    @classmethod
+    def fromCheckpointWithSignature(cls, checkpoint_dir: str,
+                                    signature_def_key: str) -> "TFInputGraph":
+        """From a checkpoint whose MetaGraph carries a signature_def."""
+        graph_def, meta = _load_checkpoint(checkpoint_dir, None,
+                                           signature_def_key)
+        in_map, out_map = _signature_mappings(meta, signature_def_key)
+        return cls(graph_def=graph_def, input_mapping=in_map,
+                   output_mapping=out_map)
+
+    @classmethod
+    def fromSavedModel(cls, saved_model_dir: str, tag_set: str,
+                       feed_names: Sequence[str],
+                       fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a SavedModel with explicit feed/fetch names."""
+        graph_def, _ = _load_saved_model(saved_model_dir, tag_set,
+                                         fetch_names)
+        return cls(
+            graph_def=graph_def,
+            input_mapping={n: tensor_name(n) for n in feed_names},
+            output_mapping={tensor_name(n): n for n in fetch_names})
+
+    @classmethod
+    def fromSavedModelWithSignature(cls, saved_model_dir: str, tag_set: str,
+                                    signature_def_key: str) -> "TFInputGraph":
+        """From a SavedModel using its signature_def feeds/fetches."""
+        graph_def, meta = _load_saved_model(saved_model_dir, tag_set, None,
+                                            signature_def_key)
+        in_map, out_map = _signature_mappings(meta, signature_def_key)
+        return cls(graph_def=graph_def, input_mapping=in_map,
+                   output_mapping=out_map)
+
+
+# ---------------------------------------------------------------------------
+# TF-side loading/freezing helpers
+
+
+def _freeze(sess, graph_def, fetch_names: Sequence[str]):
+    tf = _tf()
+
+    out_ops = [op_name(n) for n in fetch_names]
+    return tf.compat.v1.graph_util.convert_variables_to_constants(
+        sess, graph_def, out_ops)
+
+
+def _get_signature(meta, signature_def_key: str):
+    # NB: protobuf map __getitem__ silently CREATES missing entries; always
+    # gate on membership first.
+    if signature_def_key not in meta.signature_def:
+        raise ValueError(
+            f"signature_def {signature_def_key!r} not found; available: "
+            f"{sorted(meta.signature_def)}")
+    return meta.signature_def[signature_def_key]
+
+
+def _signature_fetches(meta, signature_def_key: str) -> List[str]:
+    return [v.name for v in _get_signature(meta, signature_def_key).outputs.values()]
+
+
+def _signature_mappings(meta, signature_def_key: str
+                        ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    sig = _get_signature(meta, signature_def_key)
+    in_map = {k: v.name for k, v in sig.inputs.items()}
+    out_map = {v.name: k for k, v in sig.outputs.items()}
+    return in_map, out_map
+
+
+def _load_checkpoint(checkpoint_dir: str,
+                     fetch_names: Optional[Sequence[str]],
+                     signature_def_key: Optional[str] = None):
+    tf = _tf()
+
+    ckpt = tf.train.latest_checkpoint(checkpoint_dir)
+    if ckpt is None:
+        raise ValueError(f"No checkpoint found under {checkpoint_dir!r}")
+    # Read the stored MetaGraphDef (it carries any signature_defs; a fresh
+    # export_meta_graph would not).
+    from tensorflow.python.framework import meta_graph as _mg
+
+    meta = _mg.read_meta_graph_file(ckpt + ".meta")
+    graph = tf.compat.v1.Graph()
+    with graph.as_default():
+        with tf.compat.v1.Session(graph=graph) as sess:
+            saver = tf.compat.v1.train.import_meta_graph(meta,
+                                                         clear_devices=True)
+            saver.restore(sess, ckpt)
+            if fetch_names is None:
+                fetch_names = _signature_fetches(meta, signature_def_key)
+            frozen = _freeze(sess, graph.as_graph_def(add_shapes=True),
+                             fetch_names)
+    return frozen, meta
+
+
+def _load_saved_model(saved_model_dir: str, tag_set: str,
+                      fetch_names: Optional[Sequence[str]],
+                      signature_def_key: Optional[str] = None):
+    tf = _tf()
+
+    tags = tag_set.split(",") if isinstance(tag_set, str) else list(tag_set)
+    graph = tf.compat.v1.Graph()
+    with graph.as_default():
+        with tf.compat.v1.Session(graph=graph) as sess:
+            meta = tf.compat.v1.saved_model.loader.load(
+                sess, tags, saved_model_dir)
+            if fetch_names is None:
+                fetch_names = _signature_fetches(meta, signature_def_key)
+            frozen = _freeze(sess, graph.as_graph_def(add_shapes=True),
+                             fetch_names)
+    return frozen, meta
+
+
+# Back-compat alias used by the package exports (reference exported the
+# class under this name).
+ModelInput = TFInputGraph

@@ -1,0 +1,395 @@
+"""Frozen TF GraphDef -> jax interpreter.
+
+The TPU replacement for the reference's GraphDef execution path: where the
+reference shipped frozen GraphDefs to per-executor TF C++ sessions
+(``TFInputGraph`` consumed by ``tf_tensor.py``/``tf_image.py`` through
+TensorFrames — SURVEY.md §3.5), this walks the frozen GraphDef ONCE and
+emits a pure jax function over a constant pytree, so legacy TF-1.x models
+run as first-class XLA:TPU programs.
+
+Scope: the inference op set the reference's tests exercise (dense/conv
+nets: MatMul/Conv2D/BiasAdd/activations/pooling/BN/reshape/concat and
+elementwise math).  Unsupported ops fail loudly at import, never at trace
+time.  Graphs must be frozen (variables -> constants) — ``input.py`` does
+that with the TF CPU runtime before handing the GraphDef here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.graph.utils import op_name, output_index, tensor_name
+
+_NHWC = ("NHWC", "HWIO", "NHWC")
+
+
+def _attr(node, key, default=None):
+    if key in node.attr:
+        return node.attr[key]
+    return default
+
+
+def _attr_list_int(node, key) -> List[int]:
+    a = _attr(node, key)
+    return list(a.list.i) if a is not None else []
+
+
+def _attr_s(node, key, default=b"") -> bytes:
+    a = _attr(node, key)
+    return a.s if a is not None else default
+
+
+def _attr_i(node, key, default=0) -> int:
+    a = _attr(node, key)
+    return a.i if a is not None else default
+
+
+def _attr_f(node, key, default=0.0) -> float:
+    a = _attr(node, key)
+    return a.f if a is not None else default
+
+
+def _attr_b(node, key, default=False) -> bool:
+    a = _attr(node, key)
+    return a.b if a is not None else default
+
+
+def _padding(node) -> str:
+    pad = _attr_s(node, "padding", b"SAME").decode()
+    if pad not in ("SAME", "VALID"):
+        raise NotImplementedError(f"Unsupported padding {pad!r}")
+    return pad
+
+
+def _require_nhwc(node):
+    fmt = _attr_s(node, "data_format", b"NHWC").decode()
+    if fmt not in ("NHWC", ""):
+        raise NotImplementedError(
+            f"{node.op} node {node.name!r} uses data_format {fmt}; only "
+            f"NHWC graphs are supported")
+
+
+def _pool(x, node, kind: str):
+    from flax import linen as nn
+
+    _require_nhwc(node)
+    ksize = _attr_list_int(node, "ksize")
+    strides = _attr_list_int(node, "strides")
+    window = (ksize[1], ksize[2])
+    st = (strides[1], strides[2])
+    if kind == "max":
+        return nn.max_pool(x, window, strides=st, padding=_padding(node))
+    return nn.avg_pool(x, window, strides=st, padding=_padding(node),
+                       count_include_pad=False)
+
+
+def _reduce(jnp_fn, x, axes, node):
+    axes = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+    return jnp_fn(x, axis=axes, keepdims=_attr_b(node, "keep_dims"))
+
+
+class _Interpreter:
+    """Builds handler closures per node; executed under jax tracing."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        self.jax = jax
+        self.jnp = jnp
+
+    def run_node(self, node, inputs: List[Any]) -> Any:
+        jnp = self.jnp
+        jax = self.jax
+        op = node.op
+        if op in ("Identity", "StopGradient", "PreventGradient", "Snapshot",
+                  "CheckNumerics", "NoOp", "PlaceholderWithDefault"):
+            return inputs[0] if inputs else None
+        if op == "MatMul":
+            a, b = inputs
+            if _attr_b(node, "transpose_a"):
+                a = a.T
+            if _attr_b(node, "transpose_b"):
+                b = b.T
+            return a @ b
+        if op == "BiasAdd":
+            _require_nhwc(node)
+            return inputs[0] + inputs[1]
+        if op in ("Add", "AddV2"):
+            return inputs[0] + inputs[1]
+        if op == "AddN":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "Sub":
+            return inputs[0] - inputs[1]
+        if op == "Mul":
+            return inputs[0] * inputs[1]
+        if op in ("RealDiv", "Div"):
+            return inputs[0] / inputs[1]
+        if op == "Maximum":
+            return jnp.maximum(inputs[0], inputs[1])
+        if op == "Minimum":
+            return jnp.minimum(inputs[0], inputs[1])
+        if op == "Square":
+            return inputs[0] * inputs[0]
+        if op == "Sqrt":
+            return jnp.sqrt(inputs[0])
+        if op == "Rsqrt":
+            return 1.0 / jnp.sqrt(inputs[0])
+        if op == "Exp":
+            return jnp.exp(inputs[0])
+        if op == "Log":
+            return jnp.log(inputs[0])
+        if op == "Neg":
+            return -inputs[0]
+        if op == "Abs":
+            return jnp.abs(inputs[0])
+        if op == "Pow":
+            return inputs[0] ** inputs[1]
+        if op == "Relu":
+            return jax.nn.relu(inputs[0])
+        if op == "Relu6":
+            return jax.nn.relu6(inputs[0])
+        if op == "LeakyRelu":
+            return jax.nn.leaky_relu(inputs[0], _attr_f(node, "alpha", 0.2))
+        if op == "Elu":
+            return jax.nn.elu(inputs[0])
+        if op == "Selu":
+            return jax.nn.selu(inputs[0])
+        if op == "Sigmoid":
+            return jax.nn.sigmoid(inputs[0])
+        if op == "Tanh":
+            return jnp.tanh(inputs[0])
+        if op == "Softplus":
+            return jax.nn.softplus(inputs[0])
+        if op == "Softmax":
+            return jax.nn.softmax(inputs[0], axis=-1)
+        if op == "LogSoftmax":
+            return jax.nn.log_softmax(inputs[0], axis=-1)
+        if op == "Conv2D":
+            import jax.lax as lax
+
+            strides = _attr_list_int(node, "strides")
+            dil = _attr_list_int(node, "dilations") or [1, 1, 1, 1]
+            fmt = _attr_s(node, "data_format", b"NHWC").decode()
+            if fmt != "NHWC":
+                raise NotImplementedError(f"Conv2D data_format {fmt}")
+            return lax.conv_general_dilated(
+                inputs[0], inputs[1],
+                window_strides=(strides[1], strides[2]),
+                padding=_padding(node),
+                rhs_dilation=(dil[1], dil[2]),
+                dimension_numbers=_NHWC)
+        if op == "DepthwiseConv2dNative":
+            import jax.lax as lax
+
+            strides = _attr_list_int(node, "strides")
+            k = inputs[1]
+            kh, kw, cin, mult = k.shape
+            return lax.conv_general_dilated(
+                inputs[0], k.reshape(kh, kw, 1, cin * mult),
+                window_strides=(strides[1], strides[2]),
+                padding=_padding(node),
+                feature_group_count=cin,
+                dimension_numbers=_NHWC)
+        if op == "MaxPool":
+            return _pool(inputs[0], node, "max")
+        if op == "AvgPool":
+            return _pool(inputs[0], node, "avg")
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            _require_nhwc(node)
+            x, gamma, beta, mean, var = inputs[:5]
+            eps = _attr_f(node, "epsilon", 1e-3)
+            return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+        if op == "Mean":
+            return _reduce(jnp.mean, inputs[0], inputs[1], node)
+        if op == "Sum":
+            return _reduce(jnp.sum, inputs[0], inputs[1], node)
+        if op == "Max":
+            return _reduce(jnp.max, inputs[0], inputs[1], node)
+        if op == "Min":
+            return _reduce(jnp.min, inputs[0], inputs[1], node)
+        if op == "Reshape":
+            shape = [int(v) for v in np.asarray(inputs[1]).reshape(-1)]
+            return inputs[0].reshape(shape)
+        if op == "Squeeze":
+            dims = _attr_list_int(node, "squeeze_dims")
+            return jnp.squeeze(inputs[0],
+                               axis=tuple(dims) if dims else None)
+        if op == "ExpandDims":
+            return jnp.expand_dims(inputs[0], int(np.asarray(inputs[1])))
+        if op == "ConcatV2":
+            axis = int(np.asarray(inputs[-1]))
+            return jnp.concatenate(inputs[:-1], axis=axis)
+        if op == "Pad":
+            pads = np.asarray(inputs[1]).tolist()
+            return jnp.pad(inputs[0], pads)
+        if op == "Transpose":
+            perm = [int(v) for v in np.asarray(inputs[1]).reshape(-1)]
+            return jnp.transpose(inputs[0], perm)
+        if op == "Cast":
+            import tensorflow as tf
+
+            dst = tf.dtypes.as_dtype(_attr(node, "DstT").type).as_numpy_dtype
+            return inputs[0].astype(dst)
+        raise NotImplementedError(
+            f"TF op {op!r} (node {node.name!r}) is not supported by the "
+            f"GraphDef->jax importer")
+
+
+# Every op run_node can lower — membership checked eagerly at import.
+_SUPPORTED_OPS = frozenset({
+    "Identity", "StopGradient", "PreventGradient", "Snapshot",
+    "CheckNumerics", "NoOp", "PlaceholderWithDefault",
+    "MatMul", "Add", "AddV2", "BiasAdd", "AddN", "Sub", "Mul", "RealDiv",
+    "Div", "Maximum", "Minimum", "Square", "Sqrt", "Rsqrt", "Exp", "Log",
+    "Neg", "Abs", "Pow",
+    "Relu", "Relu6", "LeakyRelu", "Elu", "Selu", "Sigmoid", "Tanh",
+    "Softplus", "Softmax", "LogSoftmax",
+    "Conv2D", "DepthwiseConv2dNative", "MaxPool", "AvgPool",
+    "FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3",
+    "Mean", "Sum", "Max", "Min",
+    "Reshape", "Squeeze", "ExpandDims", "ConcatV2", "Pad", "Transpose",
+    "Cast",
+})
+
+_STRUCTURAL = frozenset({"Placeholder", "Const"})
+
+# Input slots that must be STATIC (python/numpy) values at trace time:
+# shapes, axes, permutations, pad widths.  These are resolved from the
+# graph's constants on the host, never from the traced pytree.
+_STATIC_ARG_SLOTS = {
+    "Reshape": (1,),
+    "ExpandDims": (1,),
+    "Pad": (1,),
+    "Transpose": (1,),
+    "Mean": (1,),
+    "Sum": (1,),
+    "Max": (1,),
+    "Min": (1,),
+}
+
+
+def graphdef_to_jax(graph_def, feed_names: Sequence[str],
+                    fetch_names: Sequence[str]) -> ModelFunction:
+    """Compile a FROZEN GraphDef into a ModelFunction.
+
+    ``feed_names``/``fetch_names`` accept either ``"op"`` or ``"op:k"``
+    forms (the reference's naming contract, ``graph/utils.py``).
+    Constants become the ModelFunction's variable pytree (so big weight
+    tensors live in the params slot, not baked into the traced program).
+    """
+    from tensorflow.python.framework import tensor_util
+
+    nodes = {n.name: n for n in graph_def.node}
+    feeds = [tensor_name(f) for f in feed_names]
+    fetches = [tensor_name(f) for f in fetch_names]
+    for name in feeds + fetches:
+        if op_name(name) not in nodes:
+            raise ValueError(
+                f"{name!r} not found in graph (ops: "
+                f"{sorted(nodes)[:10]}...)")
+
+    # Validate support + collect constants eagerly (fail at import, never
+    # at trace time).
+    interp = _Interpreter()
+    consts: Dict[str, np.ndarray] = {}
+    feed_ops = {op_name(f) for f in feeds}
+    unsupported = sorted({
+        f"{n.op}({n.name})" for n in graph_def.node
+        if n.op not in _SUPPORTED_OPS and n.op not in _STRUCTURAL})
+    if unsupported:
+        raise NotImplementedError(
+            f"TF ops not supported by the GraphDef->jax importer: "
+            f"{unsupported}")
+    # The interpreter materializes output slot 0 only; any reference to a
+    # secondary output (e.g. FusedBatchNorm's batch-mean "bn:1") must fail
+    # HERE, not as an IndexError mid-trace.
+    multi_out = sorted({
+        ref for n in graph_def.node for ref in n.input
+        if not ref.startswith("^") and output_index(ref) > 0
+    } | {f for f in fetches if output_index(f) > 0})
+    if multi_out:
+        raise NotImplementedError(
+            f"References to secondary node outputs are not supported: "
+            f"{multi_out}")
+    for n in graph_def.node:
+        if n.op == "Const":
+            consts[n.name] = tensor_util.MakeNdarray(n.attr["value"].tensor)
+        elif n.op == "Placeholder" and n.name not in feed_ops:
+            raise ValueError(
+                f"Graph placeholder {n.name!r} is not covered by "
+                f"feed_names {list(feed_names)}")
+
+    def fn(variables, x):
+        if isinstance(x, dict):
+            values = {tensor_name(k): v for k, v in x.items()}
+        else:
+            if len(feeds) != 1:
+                raise ValueError(
+                    f"Graph has {len(feeds)} feeds; pass a dict")
+            values = {feeds[0]: x}
+
+        computed: Dict[str, Any] = {}
+
+        def get(ref: str):
+            # node-input refs look like "name", "name:k", or "^ctrl"
+            if ref.startswith("^"):
+                return None
+            name = tensor_name(ref)
+            if name in values:
+                return values[name]
+            if name in computed:
+                return computed[name]
+            node = nodes[op_name(name)]
+            outs = eval_node(node)
+            return outs[output_index(name)]
+
+        def static_lookup(ref: str, node):
+            name = op_name(ref)
+            # follow Identity chains to the underlying Const
+            seen = set()
+            while name in nodes and nodes[name].op == "Identity" \
+                    and name not in seen:
+                seen.add(name)
+                name = op_name(nodes[name].input[0])
+            if name in consts:
+                return consts[name]
+            raise NotImplementedError(
+                f"{node.op} node {node.name!r} has a dynamic "
+                f"shape/axis operand {ref!r}; only constant operands are "
+                f"supported")
+
+        def eval_node(node):
+            key0 = f"{node.name}:0"
+            if key0 in computed:
+                return [computed[key0]]
+            if node.op == "Placeholder":
+                raise ValueError(f"Placeholder {node.name} unfed")
+            if node.op == "Const":
+                out = variables["consts"][node.name]
+            else:
+                data_refs = [r for r in node.input if not r.startswith("^")]
+                static_slots = set(_STATIC_ARG_SLOTS.get(node.op, ()))
+                if node.op == "ConcatV2":
+                    static_slots.add(len(data_refs) - 1)
+                ins = [
+                    static_lookup(r, node) if j in static_slots else get(r)
+                    for j, r in enumerate(data_refs)]
+                out = interp.run_node(node, ins)
+            computed[key0] = out
+            return [out]
+
+        outs = [get(f) for f in fetches]
+        if len(outs) == 1:
+            return outs[0]
+        return {orig: o for orig, o in zip(fetch_names, outs)}
+
+    return ModelFunction(fn=fn, variables={"consts": consts},
+                         input_names=tuple(feed_names),
+                         output_names=tuple(fetch_names))

@@ -1,0 +1,20 @@
+"""UDF deployment layer.
+
+Replaces the reference's SQL-UDF path (``python/sparkdl/udf/
+keras_image_model.py — registerKerasImageUDF`` + ``graph/tensorframes_udf.py
+— makeGraphUDF``): a registered UDF is a vectorized callable over an
+image-struct (or tensor) column, backed by the same jit-compiled mesh engine
+the transformers use.  Standalone it applies to our Arrow DataFrame; when
+pyspark is importable, ``to_pandas_udf`` emits a real
+``pyspark.sql.functions.pandas_udf`` so ``SELECT my_udf(image) FROM ...``
+works on a Spark cluster with TPU-backed execution.
+"""
+
+from sparkdl_tpu.udf.registry import (UDFRegistry, register_image_udf,
+                                      register_udf, registerKerasImageUDF,
+                                      udf_registry)
+
+__all__ = [
+    "UDFRegistry", "register_image_udf", "register_udf",
+    "registerKerasImageUDF", "udf_registry",
+]

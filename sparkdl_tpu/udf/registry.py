@@ -1,0 +1,191 @@
+"""UDF registry + builders.
+
+``registerKerasImageUDF(name, model, preprocessor)`` keeps the reference's
+composition contract (``udf/keras_image_model.py``): [image-struct
+converter] ∘ [optional preprocessor] ∘ [model] fused into ONE program — here
+one XLA program instead of one merged GraphDef.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# Declared return types -> arrow types for apply()/pandas_udf emission.
+_RETURN_TYPES = {
+    "array<float>": pa.list_(pa.float32()),
+    "array<double>": pa.list_(pa.float64()),
+    "float": pa.float32(),
+    "double": pa.float64(),
+    "int": pa.int64(),
+    "bigint": pa.int64(),
+    "string": pa.string(),
+    "boolean": pa.bool_(),
+}
+
+
+class RegisteredUDF:
+    """A vectorized function column -> column with engine caching."""
+
+    def __init__(self, name: str, fn: Callable[[Sequence], List],
+                 returns: str = "array<float>"):
+        if returns not in _RETURN_TYPES:
+            raise ValueError(f"Unsupported UDF return type {returns!r}; "
+                             f"supported: {sorted(_RETURN_TYPES)}")
+        self.name = name
+        self.fn = fn
+        self.returns = returns
+
+    @property
+    def arrow_type(self) -> pa.DataType:
+        return _RETURN_TYPES[self.returns]
+
+    def __call__(self, column) -> List:
+        """column: sequence / pyarrow Array / pandas Series of row values."""
+        if isinstance(column, (pa.Array, pa.ChunkedArray)):
+            column = column.to_pylist()
+        elif hasattr(column, "tolist") and not isinstance(column, list):
+            column = column.tolist()
+        return self.fn(list(column))
+
+
+class UDFRegistry:
+    """Process-wide name -> UDF map (the stand-in for Spark's SQL function
+    registry; ``spark.sql`` is replaced by ``apply`` over our frames)."""
+
+    def __init__(self):
+        self._udfs: Dict[str, RegisteredUDF] = {}
+
+    def register(self, name: str, fn: Callable, returns: str = "array<float>"
+                 ) -> RegisteredUDF:
+        udf = fn if isinstance(fn, RegisteredUDF) else RegisteredUDF(
+            name, fn, returns)
+        self._udfs[name] = udf
+        logger.info("registered UDF %r", name)
+        return udf
+
+    def get(self, name: str) -> RegisteredUDF:
+        if name not in self._udfs:
+            raise KeyError(f"No UDF named {name!r}; registered: "
+                           f"{sorted(self._udfs)}")
+        return self._udfs[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._udfs)
+
+    def apply(self, name: str, dataset, inputCol: str, outputCol: str):
+        """SELECT name(inputCol) AS outputCol equivalent over a DataFrame."""
+        udf = self.get(name)
+        values = udf(dataset.table.column(inputCol))
+        return dataset.withColumn(outputCol, pa.array(
+            values, type=udf.arrow_type))
+
+    def to_pandas_udf(self, name: str):
+        """Bind to pyspark's pandas_udf when pyspark is installed (the
+        reference's [D->J] registration step; optional here)."""
+        try:
+            import pandas as pd
+            from pyspark.sql.functions import pandas_udf
+        except ImportError as e:
+            raise ImportError(
+                "pyspark is not installed; to_pandas_udf requires it "
+                f"({e})") from e
+        udf = self.get(name)
+
+        @pandas_udf(udf.returns)
+        def _udf(col: "pd.Series") -> "pd.Series":
+            return pd.Series(udf(col))
+
+        return _udf
+
+
+udf_registry = UDFRegistry()
+register_udf = udf_registry.register
+
+
+def _model_input_hw(keras_model) -> Optional[Tuple[int, int]]:
+    shape = getattr(keras_model, "input_shape", None)
+    if shape and len(shape) == 4 and shape[1] and shape[2]:
+        return int(shape[1]), int(shape[2])
+    return None
+
+
+def register_image_udf(name: str, model_function, *,
+                       input_size: Optional[Sequence[int]] = None,
+                       preprocessor: Optional[Callable] = None,
+                       batch_size: int = 32,
+                       registry: Optional[UDFRegistry] = None) -> RegisteredUDF:
+    """Register a ModelFunction as an image-column UDF.
+
+    Pipeline per call: decode/resize image structs on the host (null rows
+    stay null) -> [optional jax ``preprocessor``] ∘ model in one jit program
+    on the mesh.
+    """
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.image.io import structsToBatch
+    from sparkdl_tpu.parallel.engine import get_cached_engine
+
+    # Host batches are uint8 RGB; the struct-converter stage casts to float
+    # ([0,255], the reference's buildSpImageConverter contract) so the user
+    # preprocessor / model sees floats.
+    converter = ModelFunction.from_callable(
+        lambda x: x.astype("float32"))
+    if preprocessor is not None:
+        converter = converter.compose(
+            ModelFunction.from_callable(preprocessor))
+    model_function = converter.compose(model_function)
+    holder = _EngineHolder()  # one engine cache per registration
+
+    def fn(rows: List[Optional[dict]]) -> List[Optional[list]]:
+        valid_idx = [i for i, r in enumerate(rows) if r is not None]
+        out: List[Optional[list]] = [None] * len(rows)
+        if not valid_idx:
+            return out
+        if input_size is not None:
+            h, w = int(input_size[0]), int(input_size[1])
+        else:
+            first = rows[valid_idx[0]]
+            h, w = int(first["height"]), int(first["width"])
+        batch = structsToBatch([rows[i] for i in valid_idx], h, w)
+        eng = get_cached_engine(holder, model_function,
+                                device_batch_size=batch_size)
+        res = np.asarray(eng(batch))
+        flat = res.reshape(res.shape[0], -1).astype(np.float32)
+        for row_list, i in zip(flat.tolist(), valid_idx):
+            out[i] = row_list
+        return out
+
+    registry = registry if registry is not None else udf_registry
+    return registry.register(name, fn)
+
+
+class _EngineHolder:
+    """Plain object whose __dict__ hosts get_cached_engine's cache."""
+
+
+def registerKerasImageUDF(name: str, model_or_file, preprocessor=None,
+                          registry: Optional[UDFRegistry] = None
+                          ) -> RegisteredUDF:
+    """Reference-parity entry (``udf/keras_image_model.py``): register a
+    Keras model (object or saved file) as an image UDF, composing the
+    optional ``preprocessor`` (jax-traceable ``batch -> batch``) in front.
+    """
+    import keras
+
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    if isinstance(model_or_file, (str, bytes)):
+        model = keras.models.load_model(model_or_file, compile=False)
+    else:
+        model = model_or_file
+    mf = ModelFunction.from_keras(model)
+    return register_image_udf(
+        name, mf, input_size=_model_input_hw(model),
+        preprocessor=preprocessor, registry=registry)
