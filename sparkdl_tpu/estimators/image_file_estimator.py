@@ -65,7 +65,8 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
 
     fitParams = Param(
         "undefined", "fitParams",
-        "fit settings: {'epochs': int, 'shuffle': bool, 'seed': int}",
+        "fit settings: {'epochs': int, 'shuffle': bool, 'seed': int, "
+        "'checkpoint_dir': str, 'checkpoint_every_epochs': int}",
         typeConverter=TypeConverters.toDict)
 
     @keyword_only
@@ -150,7 +151,9 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
             batch_size=self.getBatchSize(),
             epochs=int(fp.get("epochs", 1)),
             shuffle=bool(fp.get("shuffle", True)),
-            seed=int(fp.get("seed", 0)))
+            seed=int(fp.get("seed", 0)),
+            checkpoint_dir=fp.get("checkpoint_dir"),
+            checkpoint_every_epochs=int(fp.get("checkpoint_every_epochs", 1)))
         from sparkdl_tpu.graph.function import ModelFunction
 
         fitted_mf = ModelFunction(fn=mf.fn, variables=fitted,

@@ -17,12 +17,15 @@ from sparkdl_tpu.image.schema import (
 )
 from sparkdl_tpu.image.io import (
     decodeImage,
+    decodeResizeBatch,
     resizeImage,
     readImages,
     readImagesWithCustomFn,
     filesToDF,
+    filesToModelBatch,
     createResizeImageUDF,
     PIL_decode,
+    structsToBatch,
 )
 
 __all__ = [
@@ -34,10 +37,13 @@ __all__ = [
     "imageArrayToStruct",
     "imageStructToArray",
     "decodeImage",
+    "decodeResizeBatch",
     "resizeImage",
     "readImages",
     "readImagesWithCustomFn",
     "filesToDF",
+    "filesToModelBatch",
     "createResizeImageUDF",
     "PIL_decode",
+    "structsToBatch",
 ]
