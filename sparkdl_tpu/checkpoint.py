@@ -68,10 +68,15 @@ class TrainCheckpointer:
     def _path(self, epoch: int) -> str:
         return os.path.join(self.directory, f"epoch_{epoch:06d}")
 
+    def due(self, epoch: int) -> bool:
+        """Whether the cadence saves at ``epoch`` — check this BEFORE
+        materializing device state to host so skipped epochs pay nothing."""
+        return epoch % self.every_epochs == 0
+
     def maybe_save(self, epoch: int, state: Any) -> Optional[str]:
         """Save ``state`` (any pytree — e.g. {"params":..., "opt_state":...})
         if the epoch hits the cadence; returns the path if saved."""
-        if epoch % self.every_epochs:
+        if not self.due(epoch):
             return None
         path = self._path(epoch)
         save_pytree(path, {"state": state, "epoch": epoch})

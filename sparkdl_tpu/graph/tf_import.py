@@ -337,18 +337,57 @@ def graphdef_to_jax(graph_def, feed_names: Sequence[str],
 
         computed: Dict[str, Any] = {}
 
+        def lookup(name: str):
+            # name is canonical "op:0" (multi-output refs rejected above)
+            return values[name] if name in values else computed[name]
+
+        def dynamic_refs(node):
+            data_refs = [r for r in node.input if not r.startswith("^")]
+            static_slots = set(_STATIC_ARG_SLOTS.get(node.op, ()))
+            if node.op == "ConcatV2":
+                static_slots.add(len(data_refs) - 1)
+            return data_refs, static_slots
+
         def get(ref: str):
             # node-input refs look like "name", "name:k", or "^ctrl"
             if ref.startswith("^"):
                 return None
-            name = tensor_name(ref)
-            if name in values:
-                return values[name]
-            if name in computed:
-                return computed[name]
-            node = nodes[op_name(name)]
-            outs = eval_node(node)
-            return outs[output_index(name)]
+            target = tensor_name(ref)
+            if target in values or target in computed:
+                return lookup(target)
+            # Iterative post-order evaluation: a few-hundred-node sequential
+            # chain (typical for real zoo graphs) would exceed Python's
+            # recursion limit under recursive descent.
+            stack = [op_name(target)]
+            while stack:
+                nname = stack[-1]
+                key0 = f"{nname}:0"
+                if key0 in computed or key0 in values:
+                    stack.pop()
+                    continue
+                node = nodes[nname]
+                if node.op == "Placeholder":
+                    raise ValueError(f"Placeholder {node.name} unfed")
+                if node.op == "Const":
+                    computed[key0] = variables["consts"][node.name]
+                    stack.pop()
+                    continue
+                data_refs, static_slots = dynamic_refs(node)
+                pending = [
+                    op_name(r) for j, r in enumerate(data_refs)
+                    if j not in static_slots
+                    and tensor_name(r) not in values
+                    and tensor_name(r) not in computed]
+                if pending:
+                    stack.extend(pending)
+                    continue
+                ins = [
+                    static_lookup(r, node) if j in static_slots
+                    else lookup(tensor_name(r))
+                    for j, r in enumerate(data_refs)]
+                computed[key0] = interp.run_node(node, ins)
+                stack.pop()
+            return lookup(target)
 
         def static_lookup(ref: str, node):
             name = op_name(ref)
@@ -364,26 +403,6 @@ def graphdef_to_jax(graph_def, feed_names: Sequence[str],
                 f"{node.op} node {node.name!r} has a dynamic "
                 f"shape/axis operand {ref!r}; only constant operands are "
                 f"supported")
-
-        def eval_node(node):
-            key0 = f"{node.name}:0"
-            if key0 in computed:
-                return [computed[key0]]
-            if node.op == "Placeholder":
-                raise ValueError(f"Placeholder {node.name} unfed")
-            if node.op == "Const":
-                out = variables["consts"][node.name]
-            else:
-                data_refs = [r for r in node.input if not r.startswith("^")]
-                static_slots = set(_STATIC_ARG_SLOTS.get(node.op, ()))
-                if node.op == "ConcatV2":
-                    static_slots.add(len(data_refs) - 1)
-                ins = [
-                    static_lookup(r, node) if j in static_slots else get(r)
-                    for j, r in enumerate(data_refs)]
-                out = interp.run_node(node, ins)
-            computed[key0] = out
-            return [out]
 
         outs = [get(f) for f in fetches]
         if len(outs) == 1:

@@ -12,9 +12,10 @@ Throughput design:
     size) — one compile, reused forever;
   * the tail batch is zero-padded and trimmed on the host after gather, so
     ragged input never poisons shapes;
-  * dispatch is async: the next batch's host->device transfer overlaps the
-    current batch's compute (``map_batches`` keeps a bounded in-flight
-    window; ``__call__`` dispatches every chunk before the first gather).
+  * dispatch is async with a bounded in-flight window (double buffering):
+    the next batch's host->device transfer overlaps the current batch's
+    compute, while device residency stays O(window x batch) regardless of
+    input size (both ``map_batches`` and ``__call__``).
 """
 
 from __future__ import annotations
@@ -137,12 +138,14 @@ class InferenceEngine:
         return jax.tree_util.tree_map(lambda a: a[off:off + size], batch)
 
     # -- whole-array API ---------------------------------------------------
-    def __call__(self, batch):
+    def __call__(self, batch, window: int = 2):
         """Process a full batch (array or pytree); returns host output with
         matching row count.
 
-        Every chunk is dispatched before the first gather so device compute
-        and host<->device transfer pipeline freely (XLA async dispatch).
+        Chunks run through the same bounded in-flight window as
+        ``map_batches`` (chunk k+1 transfers/computes while chunk k is
+        gathered), so device residency is O(window x device_batch) even for
+        huge inputs; only the gathered host outputs accumulate.
         """
         import time
 
@@ -152,14 +155,8 @@ class InferenceEngine:
         n = self._leaves(batch)
         if n == 0:
             raise ValueError("Empty input batch")
-        b = self.device_batch_size
         t0 = time.perf_counter()
-        pending = []
-        for off in range(0, n, b):
-            chunk = self._slice(batch, off, b)
-            k = self._leaves(chunk)
-            pending.append((k, self.run_padded(self._pad(chunk))))
-        outs = [self._trim(out, k) for k, out in pending]
+        outs = list(self.map_batches([batch], window=window))
         elapsed = time.perf_counter() - t0
         self.metrics.incr("items", n)
         self.metrics.record_time("engine_call", elapsed)

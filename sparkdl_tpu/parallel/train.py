@@ -170,8 +170,9 @@ def _epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int,
     for off in range(0, n, batch_size):
         idx = order[off:off + batch_size]
         if len(idx) < batch_size:
-            wrap = order[:batch_size - len(idx)]
-            idx = np.concatenate([idx, wrap])
+            # Modular wrap keeps the batch exactly batch_size even when the
+            # dataset is smaller than the shortfall (n < batch_size - len).
+            idx = np.take(order, np.arange(off, off + batch_size) % n)
         yield x[idx], y[idx]
 
 
@@ -243,9 +244,11 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         mean = float(np.mean([float(l) for l in losses]))
         epoch_losses.append(mean)
         metrics.record_time("epoch_loss", mean)
-        if ckptr is not None:
-            # Gathering to host does not invalidate the device arrays; the
-            # next step keeps using them (and donates them as usual).
+        if ckptr is not None and ckptr.due(epoch + 1):
+            # Gather to host only on epochs the cadence actually saves —
+            # the device->host transfer of the full state is not free.
+            # Gathering does not invalidate the device arrays; the next
+            # step keeps using them (and donates them as usual).
             host_state = jax.tree_util.tree_map(
                 np.asarray, {"params": params, "opt_state": opt_state})
             ckptr.maybe_save(epoch + 1, host_state)

@@ -286,8 +286,12 @@ class TFImageTransformer(_ImageInputStage, HasOutputMode):
         values: List[Optional[dict]] = [None] * n
         for row, i in zip(out, valid_idx):
             origin = structs[i].get("origin", "") if structs[i] else ""
-            if row.shape[-1] in (3, 4):
+            if row.shape[-1] == 3:
                 row = row[:, :, ::-1]  # model RGB -> struct BGR convention
+            elif row.shape[-1] == 4:
+                # RGBA -> BGRA: flip only the color channels, keep alpha last
+                # (the CV_8UC4/CV_32FC4 struct convention).
+                row = row[:, :, [2, 1, 0, 3]]
             values[i] = imageArrayToStruct(
                 np.ascontiguousarray(row, dtype=np.float32), origin=origin)
         return dataset.withColumn(

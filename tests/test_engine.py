@@ -129,3 +129,34 @@ def test_engine_output_is_actually_sharded(setup):
     shards = dev_out.addressable_shards
     assert len(shards) == 8
     assert all(s.data.shape == (2, 5) for s in shards)
+
+
+def test_engine_call_bounds_inflight_window(setup, monkeypatch):
+    """__call__ must gather chunk k-window before dispatching chunk k+1 —
+    device residency stays O(window), not O(n_chunks) (ADVICE round 1)."""
+    variables, x, ref = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    events = []
+    orig_run, orig_trim = eng.run_padded, eng._trim
+
+    def spy_run(batch):
+        events.append("dispatch")
+        return orig_run(batch)
+
+    monkeypatch.setattr(eng, "run_padded", spy_run)
+    monkeypatch.setattr(eng, "_trim",
+                        lambda out, n: (events.append("gather"),
+                                        orig_trim(out, n))[1])
+    out = eng(x, window=2)  # 45 rows / 8 = 6 chunks
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # With 6 chunks and window=2, the first gather must happen before the
+    # last dispatch (not all dispatches first, as in round 1).
+    first_gather = events.index("gather")
+    last_dispatch = len(events) - 1 - events[::-1].index("dispatch")
+    assert first_gather < last_dispatch, events
+    # and never more than window+1 dispatches outstanding
+    outstanding = peak = 0
+    for e in events:
+        outstanding += 1 if e == "dispatch" else -1
+        peak = max(peak, outstanding)
+    assert peak <= 3, events

@@ -256,3 +256,19 @@ def test_binary_auc():
                      "probability": [[0.9, 0.1], [0.8, 0.2],
                                      [0.3, 0.7], [0.1, 0.9]]})
     assert ev.evaluate(df2) == 0.0
+
+
+def test_epoch_batches_modular_wrap_tiny_dataset():
+    """Dataset smaller than half the batch must still yield full-size
+    batches via modular wrap-around (ADVICE round 1)."""
+    from sparkdl_tpu.parallel.train import _epoch_batches
+
+    x = np.arange(3, dtype=np.float32)[:, None]
+    y = np.arange(3, dtype=np.float32)
+    batches = list(_epoch_batches(x, y, batch_size=8, epoch=0,
+                                  shuffle=True, seed=0))
+    assert len(batches) == 1
+    bx, by = batches[0]
+    assert bx.shape == (8, 1) and by.shape == (8,)
+    # every original sample still present
+    assert set(np.unique(bx[:, 0])) == {0.0, 1.0, 2.0}

@@ -185,3 +185,22 @@ def test_name_utils():
     assert tensor_name("a/b:1") == "a/b:1"
     with pytest.raises(ValueError):
         tensor_name("a:b:c")
+
+
+def test_importer_deep_chain_no_recursion_error():
+    """A few-hundred-node sequential chain (typical for real zoo graphs)
+    must evaluate iteratively, not by recursive descent (ADVICE round 1)."""
+    tf = _tf()
+    v1 = tf.compat.v1
+    depth = 600
+    graph = v1.Graph()
+    with graph.as_default():
+        x = v1.placeholder(tf.float32, shape=[None, 3], name="x")
+        h = x
+        for i in range(depth):
+            h = tf.add(h, 1.0 / depth, name=f"add_{i}")
+        out = tf.identity(h, name="out")
+    mf = graphdef_to_jax(graph.as_graph_def(), ["x"], ["out"])
+    xv = np.zeros((2, 3), dtype=np.float32)
+    got = np.asarray(mf.fn(mf.variables, xv))
+    np.testing.assert_allclose(got, np.ones((2, 3)), rtol=1e-4)

@@ -247,3 +247,30 @@ def test_image_file_transformer(fixture_images):
     assert len(rows) == 4
     assert rows[-1]["out"] is None  # bad jpeg -> loader fails -> null
     assert all(len(r["out"]) == 2 for r in rows[:-1])
+
+
+def test_tf_image_transformer_4channel_keeps_alpha_last(image_df):
+    """RGBA model output must become BGRA in the struct (alpha stays the
+    LAST channel — CV_32FC4 convention), not ABGR (ADVICE round 1)."""
+    from sparkdl_tpu.image.schema import imageStructToArray
+
+    def add_alpha(v, x):
+        import jax.numpy as jnp
+
+        rgb = x.astype("float32")
+        alpha = jnp.full_like(rgb[..., :1], 99.0)
+        return jnp.concatenate([rgb, alpha], axis=-1)
+
+    mf = ModelFunction(fn=add_alpha, variables={})
+    t = TFImageTransformer(inputCol="image", outputCol="out",
+                           modelFunction=mf, inputSize=[16, 16],
+                           outputMode="image", batchSize=8)
+    rows = t.transform(image_df).collect()
+    vals = [r for r in rows if r["out"] is not None]
+    assert len(vals) == 3
+    for r in vals:
+        arr = imageStructToArray(r["out"])  # BGRA float32
+        assert arr.shape[-1] == 4
+        # alpha must be the last channel, everywhere 99
+        np.testing.assert_allclose(arr[..., 3], 99.0)
+        assert not np.allclose(arr[..., 0], 99.0)  # not ABGR
