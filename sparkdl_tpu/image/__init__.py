@@ -26,6 +26,8 @@ from sparkdl_tpu.image.io import (
     createResizeImageUDF,
     PIL_decode,
     structsToBatch,
+    iterFileBatches,
+    iterImageBatches,
 )
 
 __all__ = [
@@ -46,4 +48,6 @@ __all__ = [
     "createResizeImageUDF",
     "PIL_decode",
     "structsToBatch",
+    "iterFileBatches",
+    "iterImageBatches",
 ]

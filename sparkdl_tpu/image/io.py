@@ -243,24 +243,66 @@ def _list_files(path: str, recursive: bool = False) -> List[str]:
     return sorted(files)
 
 
+def iterFileBatches(path: str, batch_size: int = 64,
+                    recursive: bool = False) -> Iterable[pa.RecordBatch]:
+    """LAZILY read files under ``path`` into ``{filePath, fileData}`` record
+    batches of ``batch_size`` rows — bytes for one batch at a time, never
+    the whole directory (the streaming analog of the reference's
+    ``sc.binaryFiles`` partition iterator).  Compose with any transformer's
+    ``transformStream``."""
+    files = _list_files(path, recursive=recursive)
+    batch_size = max(1, int(batch_size))
+    for off in range(0, len(files), batch_size):
+        chunk = files[off:off + batch_size]
+        data = []
+        for f in chunk:
+            with open(f, "rb") as fh:
+                data.append(fh.read())
+        yield pa.record_batch({
+            "filePath": pa.array(chunk, type=pa.string()),
+            "fileData": pa.array(data, type=pa.binary()),
+        })
+
+
+def iterImageBatches(path: str, batch_size: int = 64, recursive: bool = False,
+                     decode_f: Callable[[bytes], Optional[np.ndarray]] = None
+                     ) -> Iterable[pa.RecordBatch]:
+    """LAZILY decode images under ``path`` into image-struct record batches
+    (null structs for undecodable files).  Peak host memory is one batch of
+    decoded images, not the dataset."""
+    decode = decode_f if decode_f is not None else PIL_decode
+    for rb in iterFileBatches(path, batch_size=batch_size,
+                              recursive=recursive):
+        files = rb.column(0).to_pylist()
+        blobs = rb.column(1).to_pylist()
+        structs = []
+        for f, blob in zip(files, blobs):
+            arr = decode(blob)
+            if arr is None:
+                structs.append(None)
+            elif isinstance(arr, dict):
+                structs.append(arr)
+            else:
+                structs.append(
+                    imageArrayToStruct(np.asarray(arr), origin=f))
+        yield pa.record_batch({"image": pa.array(structs, type=imageSchema)})
+
+
 def filesToDF(path: str, numPartitions: Optional[int] = None,
               recursive: bool = False):
     """Read raw files into a DataFrame ``{filePath: str, fileData: binary}``.
 
     Counterpart of ``imageIO.filesToDF`` (which wraps ``sc.binaryFiles``).
-    ``numPartitions`` controls batch chunking of the resulting frame.
+    ``numPartitions`` controls batch chunking of the resulting frame.  For
+    datasets that don't fit in host RAM, use :func:`iterFileBatches` +
+    ``transformStream`` instead of materializing a frame.
     """
     from sparkdl_tpu.frame import DataFrame
 
-    files = _list_files(path, recursive=recursive)
-    data = []
-    for f in files:
-        with open(f, "rb") as fh:
-            data.append(fh.read())
-    table = pa.table({
-        "filePath": pa.array(files, type=pa.string()),
-        "fileData": pa.array(data, type=pa.binary()),
-    })
+    table = pa.Table.from_batches(
+        list(iterFileBatches(path, batch_size=1 << 30, recursive=recursive)),
+        schema=pa.schema([pa.field("filePath", pa.string()),
+                          pa.field("fileData", pa.binary())]))
     df = DataFrame(table)
     if numPartitions:
         df = df.repartition(numPartitions)
@@ -272,21 +314,16 @@ def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.nd
                            recursive: bool = False):
     """Read images under ``path`` using a custom decoder into an image-struct
     DataFrame.  Counterpart of ``imageIO.readImagesWithCustomFn``; rows whose
-    decode fails become null image structs (kept, so origins stay auditable)."""
+    decode fails become null image structs (kept, so origins stay auditable).
+    For datasets that don't fit in host RAM, use :func:`iterImageBatches` +
+    ``transformStream`` instead of materializing a frame."""
     from sparkdl_tpu.frame import DataFrame
 
-    files = _list_files(path, recursive=recursive)
-    structs = []
-    for f in files:
-        with open(f, "rb") as fh:
-            arr = decode_f(fh.read())
-        if arr is None:
-            structs.append(None)
-        elif isinstance(arr, dict):
-            structs.append(arr)
-        else:
-            structs.append(imageArrayToStruct(np.asarray(arr), origin=f))
-    table = pa.table({"image": pa.array(structs, type=imageSchema)})
+    schema = pa.schema([pa.field("image", imageSchema)])
+    table = pa.Table.from_batches(
+        list(iterImageBatches(path, batch_size=256, recursive=recursive,
+                              decode_f=decode_f)),
+        schema=schema)
     df = DataFrame(table)
     if numPartitions:
         df = df.repartition(numPartitions)

@@ -23,6 +23,25 @@ class Transformer(Params):
             return self.copy(params).transform(dataset)
         return self._transform(dataset)
 
+    def transformStream(self, batches: Iterable, params: Optional[Dict] = None):
+        """Partition-at-a-time transform: lazily map an iterator of Arrow
+        ``RecordBatch``es to output ``RecordBatch``es.
+
+        This is the unbounded-dataset path — the analog of the reference's
+        per-partition executor loop (SURVEY.md §3.1): each input batch is
+        transformed independently and yielded before the next is pulled, so
+        peak memory is O(batch), not O(dataset).  Compose with the lazy
+        readers (``imageIO.iterFileBatches`` / ``iterImageBatches``) and
+        chain stages via ``PipelineModel.transformStream``."""
+        if params:
+            yield from self.copy(params).transformStream(batches)
+            return
+        from sparkdl_tpu.frame import DataFrame
+
+        for rb in batches:
+            out = self._transform(DataFrame(rb))
+            yield from out.table.to_batches()
+
     def _transform(self, dataset):
         raise NotImplementedError
 
@@ -67,6 +86,16 @@ class PipelineModel(Model):
         for stage in self.stages:
             dataset = stage.transform(dataset)
         return dataset
+
+    def transformStream(self, batches, params: Optional[Dict] = None):
+        """Lazily chain every stage's ``transformStream``: batch k flows
+        through the whole pipeline before batch k+1 is read."""
+        if params:
+            yield from self.copy(params).transformStream(batches)
+            return
+        for stage in self.stages:
+            batches = stage.transformStream(batches)
+        yield from batches
 
 
 class Pipeline(Estimator):

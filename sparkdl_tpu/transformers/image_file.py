@@ -58,11 +58,7 @@ class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
     def getModelFunction(self):
         return self.getOrDefault(self.modelFunction)
 
-    def _load_images(self, uris: List[str]):
-        """Run the user loader over URIs (on the shared host-IO pool — host
-        decode is the feed-the-chip bottleneck); returns (stacked batch,
-        valid indices).  All-failed input yields an empty batch (all-null
-        output), per the drop-to-null contract."""
+    def _safe_loader(self):
         loader = self.getImageLoader()
 
         def safe_load(uri):
@@ -75,27 +71,54 @@ class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                 logger.warning("imageLoader failed for %r: %s", uri, e)
                 return None
 
-        arrays = list(_io_executor().map(safe_load, uris))
-        valid_idx = [i for i, a in enumerate(arrays) if a is not None]
-        if not valid_idx:
-            logger.warning("imageLoader produced no usable images out of %d "
-                           "URIs; output column is all null", len(uris))
-            return np.zeros((0,), np.float32), valid_idx
-        batch = np.stack([arrays[i] for i in valid_idx]).astype(np.float32)
-        return batch, valid_idx
+        return safe_load
+
+    def _loaded_chunks(self, dataset, chunk_rows: int, valid_idx: List[int]):
+        """Generator of stacked float32 chunks over URIs whose load
+        succeeded.  Reads + decodes one record batch of files at a time (on
+        the shared host-IO pool) — the whole dataset's pixels never coexist
+        in memory; appends global indices of loadable rows to ``valid_idx``
+        as a side effect."""
+        safe_load = self._safe_loader()
+        col_idx = dataset.table.column_names.index(self.getInputCol())
+        offset = 0
+        for rb in dataset.iter_batches(chunk_rows):
+            uris = rb.column(col_idx).to_pylist()
+            arrays = list(_io_executor().map(safe_load, uris))
+            vi_local = [i for i, a in enumerate(arrays) if a is not None]
+            if vi_local:
+                valid_idx.extend(offset + i for i in vi_local)
+                yield np.stack(
+                    [arrays[i] for i in vi_local]).astype(np.float32)
+            offset += len(uris)
 
     def _transform(self, dataset):
-        uris = dataset.table.column(self.getInputCol()).to_pylist()
-        batch, valid_idx = self._load_images(uris)
-        values: List[Optional[list]] = [None] * len(uris)
-        if valid_idx:
-            mf = self.getModelFunction()
-            eng = get_cached_engine(self, mf,
+        from itertools import chain
+
+        from sparkdl_tpu.utils.prefetch import prefetch_iter
+
+        valid_idx: List[int] = []
+        chunks = self._loaded_chunks(dataset, max(1, self.getBatchSize()),
+                                     valid_idx)
+        it = prefetch_iter(chunks, depth=2)
+        first = next(it, None)
+        outs = []
+        if first is not None:
+            # Engine (weight load + compile) only once a chunk proves
+            # there's work to do.
+            eng = get_cached_engine(self, self.getModelFunction(),
                                     device_batch_size=self.getBatchSize())
-            out = np.asarray(eng(batch))
+            outs = list(eng.map_batches(chain([first], it)))
+        n = len(dataset)
+        values: List[Optional[list]] = [None] * n
+        if outs:
+            out = np.concatenate([np.asarray(o) for o in outs], axis=0)
             flat = out.reshape(out.shape[0], -1).astype(np.float32)
             for row, i in zip(flat, valid_idx):
                 values[i] = [float(v) for v in row]
+        else:
+            logger.warning("imageLoader produced no usable images out of %d "
+                           "URIs; output column is all null", n)
         return dataset.withColumn(
             self.getOutputCol(), pa.array(values, type=pa.list_(pa.float32())))
 

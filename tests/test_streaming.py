@@ -1,0 +1,230 @@
+"""Streaming data-path tests (VERDICT round 1, Missing #2).
+
+The production transform path must be partition-at-a-time like the
+reference's executor hot loop: at no point may the whole dataset's decoded
+pixels coexist in host memory, and ``transformStream`` must be lazy
+end-to-end (batch k yields before batch k+1 is read from disk).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+from PIL import Image
+
+from sparkdl_tpu.frame import DataFrame
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.image.io import (iterFileBatches, iterImageBatches,
+                                  readImages)
+from sparkdl_tpu.models import get_model_spec
+from sparkdl_tpu.transformers import (DeepImageFeaturizer, PipelineModel,
+                                      TFImageTransformer)
+from sparkdl_tpu.transformers import named_image as ni
+from sparkdl_tpu.utils.prefetch import prefetch_iter
+
+
+@pytest.fixture()
+def many_images(tmp_path):
+    """40 tiny JPEGs — 10x the device batch used below — plus 2 bad files."""
+    rng = np.random.default_rng(7)
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(40):
+        arr = (rng.random((24, 24, 3)) * 255).astype("uint8")
+        Image.fromarray(arr).save(d / f"img_{i:03d}.jpg", quality=92)
+    (d / "bad_a.jpg").write_bytes(b"nope")
+    (d / "bad_b.jpg").write_bytes(b"also nope")
+    return str(d)
+
+
+@pytest.fixture()
+def fake_resnet(monkeypatch):
+    class _Tiny:
+        feature_size = 2048
+
+        def apply(self, variables, x, train=False, features=False):
+            import jax.numpy as jnp
+
+            m = jnp.mean(x, axis=(1, 2, 3))
+            dim = self.feature_size if features else 1000
+            return m[:, None] * 0.01 + jnp.arange(
+                dim, dtype=jnp.float32)[None, :] * 1e-4
+
+    spec = get_model_spec("ResNet50")
+    monkeypatch.setitem(ni._MODEL_CACHE, "ResNet50", (_Tiny(), {}))
+    ni._ENGINE_CACHE.clear()
+    yield spec
+    ni._ENGINE_CACHE.clear()
+
+
+def test_featurizer_never_materializes_full_decoded_batch(
+        fake_resnet, many_images, monkeypatch):
+    """Decode calls must each cover at most one device batch of rows even
+    when the frame is 10x larger (the round-1 path decoded ALL rows into
+    one [N,H,W,3] array)."""
+    df = readImages(many_images)
+    assert len(df) == 42
+
+    sizes = []
+    orig = ni.structsToBatch
+
+    def spy(structs, h, w, **kw):
+        sizes.append(len(structs))
+        return orig(structs, h, w, **kw)
+
+    monkeypatch.setattr(ni, "structsToBatch", spy)
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=4)
+    rows = ft.transform(df).collect()
+    assert len(rows) == 42
+    assert sum(1 for r in rows if r["features"] is None) == 2
+    # 8-device mesh rounds batchSize=4 up to 8; decode granularity follows.
+    assert sizes, "streaming decode was never exercised"
+    assert max(sizes) <= 8, sizes
+    assert sum(sizes) == 40
+
+
+def test_streaming_matches_materialized_path(fake_resnet, many_images):
+    """Chunked streaming must produce exactly the numbers a single
+    whole-table pass produces (row order and null alignment included)."""
+    df = readImages(many_images)
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=16)
+    out1 = [r["features"] for r in ft.transform(df).collect()]
+    out2 = [r["features"] for r in
+            ft.transform(df.repartition(7)).collect()]
+    assert len(out1) == len(out2) == 42
+    for a, b in zip(out1, out2):
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_iter_file_batches_is_lazy(many_images, monkeypatch):
+    """Bytes must be read per batch, not all up front."""
+    import builtins
+
+    opened = []
+    orig_open = builtins.open
+
+    def spy_open(path, *a, **kw):
+        if str(path).endswith(".jpg"):
+            opened.append(str(path))
+        return orig_open(path, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", spy_open)
+    it = iterFileBatches(many_images, batch_size=10)
+    first = next(it)
+    assert first.num_rows == 10
+    assert len(opened) == 10  # only the first batch touched disk
+    rest = list(it)
+    assert sum(rb.num_rows for rb in rest) == 32
+    assert len(opened) == 42
+
+
+def test_transform_stream_is_lazy_end_to_end(fake_resnet, many_images):
+    """Batch k's output must be yielded before batch k+1 is decoded."""
+    events = []
+
+    def source():
+        for i, rb in enumerate(iterImageBatches(many_images, batch_size=8)):
+            events.append(f"read:{i}")
+            yield rb
+
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=8)
+    stream = ft.transformStream(source())
+    first = next(stream)
+    events.append("first-output")
+    assert first.num_rows == 8
+    assert events.index("first-output") <= 2, events  # not all 6 reads first
+    total = first.num_rows + sum(rb.num_rows for rb in stream)
+    assert total == 42
+
+
+def test_pipeline_transform_stream_chains_lazily(fake_resnet, many_images):
+    mf = ModelFunction(fn=lambda v, x: x.astype("float32").mean(
+        axis=(1, 2)), variables={})
+    t1 = TFImageTransformer(inputCol="image", outputCol="mean_bgr",
+                            modelFunction=mf, inputSize=[16, 16],
+                            outputMode="vector", batchSize=8)
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=8)
+    pm = PipelineModel([t1, ft])
+    out_batches = list(pm.transformStream(
+        iterImageBatches(many_images, batch_size=8)))
+    table = pa.Table.from_batches(out_batches)
+    assert table.num_rows == 42
+    assert set(table.column_names) >= {"image", "mean_bgr", "features"}
+
+
+def test_prefetch_iter_propagates_errors_and_order():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom")
+
+    it = prefetch_iter(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    assert list(prefetch_iter(iter(range(5)), depth=1)) == list(range(5))
+
+
+def test_image_file_transformer_streams(many_images, monkeypatch):
+    """URI-column path: files are loaded per chunk, not all at once."""
+    from sparkdl_tpu.transformers.image_file import ImageFileTransformer
+
+    paths = sorted(
+        os.path.join(many_images, f) for f in os.listdir(many_images))
+    df = DataFrame({"uri": paths})
+
+    chunk_sizes = []
+
+    def loader(uri):
+        img = Image.open(uri).convert("RGB").resize((16, 16))
+        return np.asarray(img, dtype=np.float32)
+
+    mf = ModelFunction(fn=lambda v, x: x.mean(axis=(1, 2)), variables={})
+    t = ImageFileTransformer(inputCol="uri", outputCol="out",
+                             modelFunction=mf, imageLoader=loader,
+                             batchSize=8)
+    orig = t._loaded_chunks
+
+    def spy(dataset, chunk_rows, valid_idx):
+        for chunk in orig(dataset, chunk_rows, valid_idx):
+            chunk_sizes.append(chunk.shape[0])
+            yield chunk
+
+    monkeypatch.setattr(t, "_loaded_chunks", spy)
+    rows = t.transform(df).collect()
+    assert len(rows) == 42
+    assert sum(1 for r in rows if r["out"] is None) == 2  # bad files
+    assert max(chunk_sizes) <= 8
+
+
+def test_prefetch_iter_producer_stops_when_consumer_abandons():
+    """Abandoning the consumer mid-stream must release the producer thread
+    (it was previously stuck forever in q.put on the full queue)."""
+    import threading
+    import time
+
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = prefetch_iter(gen(), depth=1)
+    assert next(it) == 0
+    it.close()  # consumer walks away
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
+    assert len(produced) < 100
