@@ -48,7 +48,12 @@ class MulticlassClassificationEvaluator(Evaluator):
         y = np.asarray(dataset.column_to_numpy(
             self.getOrDefault(self.labelCol)), dtype=np.int64)
         p = np.asarray(dataset.column_to_numpy(
-            self.getOrDefault(self.predictionCol)), dtype=np.int64)
+            self.getOrDefault(self.predictionCol)))
+        if p.ndim == 2:
+            # probability/score vectors (e.g. ImageFileModel output):
+            # argmax to class indices
+            p = np.argmax(p, axis=-1)
+        p = p.astype(np.int64)
         metric = self.getOrDefault(self.metricName)
         if metric == "accuracy":
             return float((y == p).mean())

@@ -205,11 +205,17 @@ class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
     def _transform(self, dataset):
         from sparkdl_tpu.transformers.image_file import ImageFileTransformer
 
-        t = ImageFileTransformer(
-            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
-            modelFunction=self.getModelFunction(),
-            imageLoader=self.getImageLoader(),
-            batchSize=self.getBatchSize())
+        # One persistent transformer per fitted model: repeated transforms
+        # (e.g. every CrossValidator evaluation) reuse its engine cache —
+        # weights stay device-resident instead of re-uploading per call.
+        t = self.__dict__.get("_transformer")
+        if t is None:
+            t = ImageFileTransformer(
+                inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+                modelFunction=self.getModelFunction(),
+                imageLoader=self.getImageLoader(),
+                batchSize=self.getBatchSize())
+            self.__dict__["_transformer"] = t
         return t.transform(dataset)
 
 

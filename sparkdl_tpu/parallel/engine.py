@@ -20,7 +20,7 @@ Throughput design:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
@@ -29,6 +29,20 @@ from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 
 logger = get_logger(__name__)
+
+
+# Module-level compiled-program cache: engines built around the SAME model
+# fn / mesh / donation policy share one jax.jit object (whose executable
+# cache then de-duplicates per batch shape).  A tuning grid produces many
+# fitted models over one fn with different weights — without this, every
+# model.transform() recompiled the identical program.  Keys use id(fn);
+# safe because the cached jit closes over fn, keeping the id pinned.
+_JIT_CACHE: Dict[tuple, Any] = {}
+_JIT_CACHE_CAP = 32
+
+
+def clear_engine_jit_cache() -> None:
+    _JIT_CACHE.clear()
 
 
 def _cast_floating(variables, dtype):
@@ -80,11 +94,20 @@ class InferenceEngine:
         # Params live on device once — the NamedSharding replicate is the TPU
         # analog of the reference's model-GraphDef broadcast.
         self.variables = jax.device_put(variables, self._replicated)
-        self._compiled = jax.jit(
-            fn,
-            in_shardings=(self._replicated, self._batch_sharding),
-            out_shardings=self._batch_sharding,
-            donate_argnums=(1,) if donate_batch else ())
+        key = (id(fn),
+               tuple(d.id for d in self.mesh.devices.flat),
+               tuple(self.mesh.axis_names), bool(donate_batch))
+        compiled = _JIT_CACHE.get(key)
+        if compiled is None:
+            compiled = jax.jit(
+                fn,
+                in_shardings=(self._replicated, self._batch_sharding),
+                out_shardings=self._batch_sharding,
+                donate_argnums=(1,) if donate_batch else ())
+            while len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+                _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+            _JIT_CACHE[key] = compiled
+        self._compiled = compiled
 
     # -- low level ---------------------------------------------------------
     @staticmethod

@@ -120,9 +120,30 @@ class TrainStep:
         return self.step_fn(params, opt_state, x, y)
 
 
+# TrainStep cache: one compiled step per (model fn, loss, optimizer, mesh).
+# This is what makes a param grid x k folds compile ONCE (SURVEY.md §7 hard
+# part #5): fitMultiple / CrossValidator re-enter make_train_step with the
+# same constituents and get back the same jit object, whose own executable
+# cache then hits on equal batch shapes.  Keys use object ids — safe because
+# the cached TrainStep's closure keeps every keyed object alive, so ids
+# cannot be recycled while the entry exists.
+_STEP_CACHE: Dict[tuple, "TrainStep"] = {}
+_STEP_CACHE_CAP = 16
+
+
+def clear_train_step_cache() -> None:
+    _STEP_CACHE.clear()
+    _OPT_INSTANCES.clear()
+
+
+def _mesh_key(mesh) -> tuple:
+    return (tuple(d.id for d in mesh.devices.flat), tuple(mesh.axis_names),
+            tuple(mesh.devices.shape))
+
+
 def make_train_step(predict_fn: Callable, loss, optimizer,
-                    mesh=None) -> TrainStep:
-    """Build the jit-compiled data-parallel train step.
+                    mesh=None, cache: bool = True) -> TrainStep:
+    """Build (or fetch the cached) jit-compiled data-parallel train step.
 
     ``predict_fn(params, x) -> pred``; ``loss(pred, y) -> [B]``;
     ``optimizer`` is an optax GradientTransformation.  The mean over the
@@ -132,6 +153,13 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
     import jax.numpy as jnp
 
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    key = (id(predict_fn),
+           loss if isinstance(loss, str) else id(loss),
+           id(optimizer), _mesh_key(mesh))
+    if cache:
+        cached = _STEP_CACHE.get(key)
+        if cached is not None:
+            return cached
     replicated = mesh_lib.replicated_sharding(mesh)
     batch_sharded = mesh_lib.batch_sharding(mesh)
     loss_fn = resolve_loss(loss)
@@ -153,8 +181,38 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
         in_shardings=(replicated, replicated, batch_sharded, batch_sharded),
         out_shardings=(replicated, replicated, replicated),
         donate_argnums=(0, 1))
-    return TrainStep(step_fn=step_fn, mesh=mesh, replicated=replicated,
-                     batch_sharded=batch_sharded)
+    result = TrainStep(step_fn=step_fn, mesh=mesh, replicated=replicated,
+                       batch_sharded=batch_sharded)
+    if cache:
+        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+        _STEP_CACHE[key] = result
+    return result
+
+
+_OPT_INSTANCES: Dict[int, Any] = {}
+_DEFAULT_OPTIMIZER = None
+
+
+def _resolve_optimizer(optimizer):
+    """Resolve None/factory forms to a STABLE GradientTransformation so the
+    step cache can key on identity (a fresh adam per fit would defeat it)."""
+    import optax
+
+    global _DEFAULT_OPTIMIZER
+    if optimizer is None:
+        if _DEFAULT_OPTIMIZER is None:
+            _DEFAULT_OPTIMIZER = optax.adam(1e-3)
+        return _DEFAULT_OPTIMIZER
+    if callable(optimizer) and not isinstance(
+            optimizer, optax.GradientTransformation):
+        # factory form from the param converter: one instance per factory
+        inst = _OPT_INSTANCES.get(id(optimizer))
+        if inst is None:
+            inst = (optimizer, optimizer())  # pin factory so its id is stable
+            _OPT_INSTANCES[id(optimizer)] = inst
+        return inst[1]
+    return optimizer
 
 
 def _epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int,
@@ -200,14 +258,8 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
     delegated to Spark task retry).
     """
     import jax
-    import optax
 
-    if optimizer is None:
-        optimizer = optax.adam(1e-3)
-    if callable(optimizer) and not isinstance(
-            optimizer, optax.GradientTransformation):
-        optimizer = optimizer()  # factory form from the param converter
-
+    optimizer = _resolve_optimizer(optimizer)
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
     dp = mesh.shape[mesh_lib.DATA_AXIS]
     if batch_size % dp:

@@ -1,0 +1,157 @@
+"""Compile-once guarantees across param maps and folds (VERDICT round 1,
+Missing/Weak #3 — SURVEY.md §7 hard part #5).
+
+A tuning grid must not pay one XLA compile per (map, fold): the TrainStep
+cache keys on (predict fn, loss, optimizer, mesh) and jax.jit's own
+executable cache de-duplicates equal batch shapes, so the whole grid
+compiles once.  Same for inference: fitted models over one fn share the
+compiled program.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.estimators import (CrossValidator, ImageFileEstimator,
+                                    MulticlassClassificationEvaluator)
+from sparkdl_tpu.frame import DataFrame
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.parallel import train as train_lib
+from sparkdl_tpu.parallel.engine import InferenceEngine, clear_engine_jit_cache
+from sparkdl_tpu.parallel.train import (clear_train_step_cache,
+                                        fit_data_parallel, make_train_step)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_train_step_cache()
+    clear_engine_jit_cache()
+    yield
+    clear_train_step_cache()
+    clear_engine_jit_cache()
+
+
+def _counting_predict():
+    traces = []
+
+    def predict(p, xb):
+        import jax.numpy as jnp
+
+        traces.append(1)  # increments once per TRACE, not per step
+        return jnp.asarray(xb).reshape(xb.shape[0], -1) @ p["w"]
+
+    return predict, traces
+
+
+def test_make_train_step_returns_same_object_for_same_key():
+    import optax
+
+    predict, _ = _counting_predict()
+    opt = optax.sgd(0.1)
+    s1 = make_train_step(predict, "mse", opt)
+    s2 = make_train_step(predict, "mse", opt)
+    assert s1 is s2
+    # different loss -> different step
+    s3 = make_train_step(predict, "mae", opt)
+    assert s3 is not s1
+
+
+def test_repeated_fits_trace_once():
+    import optax
+
+    predict, traces = _counting_predict()
+    opt = optax.sgd(0.1)
+    x = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    y = (x @ np.ones((4, 1), np.float32))
+
+    params = {"w": np.zeros((4, 1), np.float32)}
+    fit_data_parallel(predict, params, x, y, optimizer=opt, loss="mse",
+                      batch_size=8, epochs=2)
+    first = len(traces)
+    assert first >= 1
+    # 3 more fits, same shapes/opt/loss: ZERO new traces
+    for _ in range(3):
+        fit_data_parallel(predict, params, x, y, optimizer=opt, loss="mse",
+                          batch_size=8, epochs=1)
+    assert len(traces) == first
+
+
+def test_default_optimizer_is_stable_across_fits():
+    predict, traces = _counting_predict()
+    x = np.zeros((16, 4), np.float32)
+    y = np.zeros((16, 1), np.float32)
+    params = {"w": np.zeros((4, 1), np.float32)}
+    fit_data_parallel(predict, params, x, y, loss="mse", batch_size=8,
+                      epochs=1)
+    first = len(traces)
+    fit_data_parallel(predict, params, x, y, loss="mse", batch_size=8,
+                      epochs=1)
+    assert len(traces) == first  # optimizer=None resolved to one instance
+
+
+def _loader(uri):
+    from PIL import Image
+
+    img = Image.open(uri).convert("RGB").resize((8, 8))
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def test_grid_times_folds_compiles_once(fixture_images):
+    """4 param maps x 3 folds + the final refit: one trace total for the
+    train step and one for inference."""
+    import jax.numpy as jnp
+
+    train_traces = []
+    rng = np.random.default_rng(0)
+    variables = {"w": rng.normal(0, 0.01, (8 * 8 * 3, 2)).astype(np.float32)}
+
+    def fn(v, xb):
+        train_traces.append(1)
+        logits = xb.reshape(xb.shape[0], -1) @ v["w"]
+        return jnp.exp(logits) / jnp.sum(jnp.exp(logits), axis=-1,
+                                         keepdims=True)
+
+    mf = ModelFunction(fn=fn, variables=variables)
+    paths = fixture_images["paths"] * 8  # 24 rows
+    labels = [i % 2 for i in range(len(paths))]
+    df = DataFrame({
+        "uri": paths,
+        "label": [[1.0, 0.0] if l == 0 else [0.0, 1.0] for l in labels],
+        "labelIdx": np.asarray(labels, np.int64),
+    })
+
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader, optimizer="sgd",
+        loss="categorical_crossentropy", fitParams={"epochs": 1},
+        batchSize=8)
+    maps = [{est.fitParams: {"epochs": e, "seed": s}}
+            for e in (1, 2) for s in (0, 1)]  # 4 maps
+    ev = MulticlassClassificationEvaluator(predictionCol="preds",
+                                           labelCol="labelIdx")
+    cv = CrossValidator(estimator=est, estimatorParamMaps=maps,
+                        evaluator=ev, numFolds=3)
+    model = cv.fit(df)
+    assert len(model.avgMetrics) == 4
+    # fn traces: once for the train step (inside value_and_grad) and once
+    # for the inference engine — NOT once per (map, fold).
+    assert len(train_traces) <= 3, (
+        f"expected <=3 traces for 4 maps x 3 folds, got {len(train_traces)}")
+
+
+def test_engines_share_compiled_program_across_weight_sets():
+    def fn(v, x):
+        import jax.numpy as jnp
+
+        return jnp.tanh(x @ v["w"])
+
+    rng = np.random.default_rng(1)
+    v1 = {"w": rng.normal(size=(4, 3)).astype(np.float32)}
+    v2 = {"w": rng.normal(size=(4, 3)).astype(np.float32)}
+    e1 = InferenceEngine(fn, v1, device_batch_size=8)
+    e2 = InferenceEngine(fn, v2, device_batch_size=8)
+    assert e1._compiled is e2._compiled  # one program, two weight sets
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(e1(x)), np.tanh(x @ v1["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(e2(x)), np.tanh(x @ v2["w"]),
+                               rtol=1e-5, atol=1e-6)
