@@ -71,14 +71,55 @@ class ModelSpec:
             lambda r, x: module.init(r, x, train=False),
             jax.random.PRNGKey(0), dummy)
 
+    def resolve_weights(self, weights: Optional[str] = "imagenet"
+                        ) -> Optional[str]:
+        """Resolve the ``weights`` argument against the offline bundle.
+
+        ``weights="imagenet"`` checks ``$SPARKDL_WEIGHTS_DIR`` for a local
+        file first (air-gapped deployments — the analog of the reference's
+        packaged, build-time-fetched GraphDefs in ``Models.scala``); an
+        explicit path is returned as-is (and must exist)."""
+        import os
+
+        if weights is None:
+            return None
+        if weights != "imagenet":
+            if not os.path.isfile(weights):
+                raise FileNotFoundError(
+                    f"weights file {weights!r} does not exist")
+            return weights
+        wdir = os.environ.get("SPARKDL_WEIGHTS_DIR")
+        if wdir:
+            stems = {self.name, self.name.lower(), self.keras_app,
+                     self.keras_app.lower()}
+            for stem in sorted(stems):
+                for ext in (".weights.h5", ".h5", ".keras"):
+                    cand = os.path.join(wdir, stem + ext)
+                    if os.path.isfile(cand):
+                        logger.info("Using offline weights %s", cand)
+                        return cand
+        return "imagenet"
+
     def keras_model(self, weights: Optional[str] = "imagenet"):
         """Build the keras.applications twin (CPU; used for weight import and
-        as the parity oracle, mirroring the reference's test strategy)."""
+        as the parity oracle, mirroring the reference's test strategy).
+
+        ``weights`` may be "imagenet" (keras download cache, with
+        ``$SPARKDL_WEIGHTS_DIR`` consulted first), a ``.weights.h5`` file
+        (loaded into the twin architecture), a full ``.h5``/``.keras`` model
+        file, or None (random init)."""
         import keras
 
         builder = getattr(keras.applications, self.keras_app)
+        resolved = self.resolve_weights(weights)
+        if resolved is not None and resolved != "imagenet":
+            if resolved.endswith(".weights.h5"):
+                model = builder(weights=None)
+                model.load_weights(resolved)
+                return model
+            return keras.saving.load_model(resolved)
         try:
-            return builder(weights=weights)
+            return builder(weights=resolved)
         except Exception as e:
             # Only the default imagenet download may degrade gracefully (no
             # network / no cache); an explicit user weight path must fail.
@@ -86,7 +127,9 @@ class ModelSpec:
                 raise
             logger.warning(
                 "Could not load %s imagenet weights (%s); falling back to "
-                "random initialization", self.name, e)
+                "random initialization. For air-gapped use, point "
+                "SPARKDL_WEIGHTS_DIR at a directory holding "
+                "<model>.weights.h5 / .h5 / .keras files", self.name, e)
             return builder(weights=None)
 
 class _Registry:

@@ -21,14 +21,59 @@ _CLASS_INDEX = None          # idx -> (synset_id, description)
 _CLASS_INDEX_TRIED = False
 
 
+def reset_class_index_cache():
+    global _CLASS_INDEX, _CLASS_INDEX_TRIED
+    _CLASS_INDEX = None
+    _CLASS_INDEX_TRIED = False
+
+
+def _class_index_candidates():
+    """Air-gap-friendly resolution order for the class-index JSON:
+
+    1. ``SPARKDL_CLASS_INDEX`` — explicit file path
+    2. ``<package>/models/data/imagenet_class_index.json`` — vendored copy
+       (drop the public 35 KB file here for fully offline deployments)
+    3. ``$SPARKDL_WEIGHTS_DIR/imagenet_class_index.json`` — alongside the
+       offline weight bundle
+    4. the keras cache (``~/.keras/models/``) if a previous download exists
+    """
+    import os
+
+    explicit = os.environ.get("SPARKDL_CLASS_INDEX")
+    if explicit:
+        yield explicit
+    yield os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "imagenet_class_index.json")
+    wdir = os.environ.get("SPARKDL_WEIGHTS_DIR")
+    if wdir:
+        yield os.path.join(wdir, "imagenet_class_index.json")
+    yield os.path.expanduser("~/.keras/models/imagenet_class_index.json")
+
+
+def _parse_class_index(path):
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    return {int(k): (v[0], v[1]) for k, v in raw.items()}
+
+
 def _load_class_index():
     global _CLASS_INDEX, _CLASS_INDEX_TRIED
     if _CLASS_INDEX_TRIED:
         return _CLASS_INDEX
     _CLASS_INDEX_TRIED = True
-    try:
-        import json
+    import os
 
+    for path in _class_index_candidates():
+        if not os.path.isfile(path):
+            continue
+        try:
+            _CLASS_INDEX = _parse_class_index(path)
+            return _CLASS_INDEX
+        except Exception as e:
+            logger.warning("Bad class-index file %s (%s); trying next", path, e)
+    try:  # last resort: download through the keras cache
         from keras.utils import get_file
 
         path = get_file(
@@ -36,13 +81,12 @@ def _load_class_index():
             "https://storage.googleapis.com/download.tensorflow.org/"
             "data/imagenet_class_index.json",
             cache_subdir="models")
-        with open(path) as f:
-            raw = json.load(f)
-        _CLASS_INDEX = {int(k): (v[0], v[1]) for k, v in raw.items()}
+        _CLASS_INDEX = _parse_class_index(path)
     except Exception as e:
         logger.warning(
             "ImageNet class index unavailable (%s); topK decode will use "
-            "synthetic class ids", e)
+            "synthetic class ids. Provide it offline via SPARKDL_CLASS_INDEX "
+            "or the package data dir (see _class_index_candidates)", e)
         _CLASS_INDEX = None
     return _CLASS_INDEX
 

@@ -1,0 +1,109 @@
+"""Offline pretrained-weight bundle + air-gapped class index (VERDICT
+round 1, Missing #3): the zoo must load real weights from a local file with
+no network, and topK decode must use a locally provided class index.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models import get_model_spec, load_model
+from sparkdl_tpu.models import imagenet as imagenet_lib
+
+
+@pytest.fixture(autouse=True)
+def _reset_class_index():
+    imagenet_lib.reset_class_index_cache()
+    yield
+    imagenet_lib.reset_class_index_cache()
+
+
+def test_explicit_weights_path_must_exist():
+    spec = get_model_spec("ResNet50")
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        spec.resolve_weights("/no/such/file.h5")
+
+
+def test_weights_dir_resolution(tmp_path, monkeypatch):
+    spec = get_model_spec("ResNet50")
+    # no dir set -> passthrough
+    monkeypatch.delenv("SPARKDL_WEIGHTS_DIR", raising=False)
+    assert spec.resolve_weights("imagenet") == "imagenet"
+    # dir set but empty -> passthrough
+    monkeypatch.setenv("SPARKDL_WEIGHTS_DIR", str(tmp_path))
+    assert spec.resolve_weights("imagenet") == "imagenet"
+    # candidate file present -> picked up
+    cand = tmp_path / "ResNet50.weights.h5"
+    cand.write_bytes(b"")
+    assert spec.resolve_weights("imagenet") == str(cand)
+    assert spec.resolve_weights(None) is None
+
+
+def test_load_model_from_local_weights_matches_keras_twin(tmp_path,
+                                                          monkeypatch):
+    """End-to-end: keras twin (random init, randomized BN) saves weights;
+    load_model with SPARKDL_WEIGHTS_DIR set must produce the twin's exact
+    predictions — proving the local file was loaded, not a fresh init."""
+    import jax
+
+    name = "ResNet50"
+    spec = get_model_spec(name)
+    twin = spec.keras_model(weights=None)
+    # make BN stats non-trivial so a fresh random init can't accidentally agree
+    rng = np.random.default_rng(3)
+    for layer in twin.layers:
+        if type(layer).__name__ == "BatchNormalization":
+            ws = layer.get_weights()
+            layer.set_weights([
+                w + rng.normal(0, 0.05, size=w.shape).astype("float32")
+                for w in ws])
+    wpath = str(tmp_path / f"{name}.weights.h5")
+    twin.save_weights(wpath)
+    monkeypatch.setenv("SPARKDL_WEIGHTS_DIR", str(tmp_path))
+
+    module, variables = load_model(name)  # default "imagenet" -> local file
+    h, w = spec.input_size
+    x = rng.normal(0, 1, size=(2, h, w, 3)).astype("float32")
+    ref = np.asarray(twin.predict(x, verbose=0))
+    got = np.asarray(jax.jit(
+        lambda v, xb: module.apply(v, xb, train=False))(variables, x))
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=2e-3)
+
+
+def test_class_index_from_env_file(tmp_path, monkeypatch):
+    index = {str(i): [f"n{i:08d}", f"thing_{i}"] for i in range(10)}
+    path = tmp_path / "imagenet_class_index.json"
+    path.write_text(json.dumps(index))
+    monkeypatch.setenv("SPARKDL_CLASS_INDEX", str(path))
+    imagenet_lib.reset_class_index_cache()
+
+    probs = np.zeros((1, 10), np.float32)
+    probs[0, 3] = 0.9
+    probs[0, 7] = 0.1
+    decoded = imagenet_lib.decode_predictions(probs, top=2)
+    assert decoded[0][0] == ("n00000003", "thing_3", pytest.approx(0.9))
+    assert decoded[0][1][1] == "thing_7"
+
+
+def test_class_index_from_weights_dir(tmp_path, monkeypatch):
+    index = {"0": ["n0", "zero"], "1": ["n1", "one"]}
+    (tmp_path / "imagenet_class_index.json").write_text(json.dumps(index))
+    monkeypatch.delenv("SPARKDL_CLASS_INDEX", raising=False)
+    monkeypatch.setenv("SPARKDL_WEIGHTS_DIR", str(tmp_path))
+    imagenet_lib.reset_class_index_cache()
+    decoded = imagenet_lib.decode_predictions(
+        np.asarray([[0.2, 0.8]], np.float32), top=1)
+    assert decoded[0][0][:2] == ("n1", "one")
+
+
+def test_class_index_degrades_to_synthetic(monkeypatch, tmp_path):
+    monkeypatch.delenv("SPARKDL_CLASS_INDEX", raising=False)
+    monkeypatch.setenv("SPARKDL_WEIGHTS_DIR", str(tmp_path))  # empty dir
+    monkeypatch.setenv("HOME", str(tmp_path))  # hide any keras cache
+    imagenet_lib.reset_class_index_cache()
+    decoded = imagenet_lib.decode_predictions(
+        np.asarray([[0.2, 0.8]], np.float32), top=1)
+    assert decoded[0][0][0] == "class_1"
