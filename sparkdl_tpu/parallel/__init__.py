@@ -10,10 +10,12 @@ instead of Spark shuffle/broadcast.
 from sparkdl_tpu.parallel.mesh import (batch_sharding, get_mesh,
                                        replicated_sharding)
 from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel import distributed
 
 __all__ = [
     "InferenceEngine",
     "batch_sharding",
+    "distributed",
     "get_mesh",
     "replicated_sharding",
 ]

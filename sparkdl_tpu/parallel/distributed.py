@@ -1,0 +1,115 @@
+"""Multi-host (multi-controller) scaffolding.
+
+SURVEY.md §2 "distributed communication backend": the reference moved data
+with Spark's machinery (torrent broadcast, shuffles); the TPU equivalent is
+multi-controller JAX — one process per host, ``jax.distributed`` for
+runtime bootstrap, deterministic per-host file sharding instead of a
+shuffle, and ``jax.make_array_from_process_local_data`` to assemble global
+device arrays from each host's local rows (collectives then ride ICI/DCN
+via the mesh).  Everything degrades to a no-op in the common one-process
+case, so the same estimator code runs from one chip to a pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               **kwargs) -> bool:
+    """Bootstrap multi-controller JAX.  Returns True if ``jax.distributed``
+    was initialized, False for the single-process degenerate run (no-op).
+
+    Mirrors ``jax.distributed.initialize`` semantics: all three arguments
+    may be None when the environment provides them (TPU pod metadata /
+    cluster env vars); an explicit ``num_processes=1`` (or leaving
+    everything unset outside a cluster) skips initialization entirely.
+    """
+    global _INITIALIZED
+    import jax
+
+    if _INITIALIZED:
+        logger.info("jax.distributed already initialized; skipping")
+        return True
+    explicit = any(v is not None
+                   for v in (coordinator_address, num_processes, process_id))
+    if not explicit or num_processes in (0, 1):
+        logger.info("single-process run; jax.distributed not initialized")
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+    _INITIALIZED = True
+    logger.info("jax.distributed initialized: process %d/%d, %d local / %d "
+                "global devices", jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+    return True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def shard_files(paths: Sequence[str], index: Optional[int] = None,
+                count: Optional[int] = None) -> List[str]:
+    """Deterministic per-host shard of a file list.
+
+    Sorted then strided (``sorted(paths)[index::count]``): every host
+    derives the same global order independently — no coordination, no
+    shuffle service — and shard sizes differ by at most one file.  This
+    replaces the reference's Spark partition assignment for ingest.
+    """
+    idx = process_index() if index is None else int(index)
+    cnt = process_count() if count is None else int(count)
+    if cnt < 1:
+        raise ValueError(f"count must be >= 1, got {cnt}")
+    if not (0 <= idx < cnt):
+        raise ValueError(f"index {idx} out of range for count {cnt}")
+    return sorted(paths)[idx::cnt]
+
+
+def local_batch_size(global_batch_size: int,
+                     count: Optional[int] = None) -> int:
+    """Rows THIS host contributes per global batch."""
+    cnt = process_count() if count is None else int(count)
+    if global_batch_size % cnt:
+        raise ValueError(
+            f"global batch {global_batch_size} is not divisible by "
+            f"{cnt} processes")
+    return global_batch_size // cnt
+
+
+def put_sharded(sharding, data: Any):
+    """Place a host batch onto devices under ``sharding``.
+
+    Single-process: a plain ``device_put``.  Multi-controller: each process
+    passes its LOCAL rows and ``jax.make_array_from_process_local_data``
+    assembles the global array (global batch = sum of local rows) — the
+    per-host data path SURVEY.md §2 names as the broadcast/shuffle
+    replacement.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(data, sharding)
+    return jax.tree_util.tree_map(
+        lambda a: jax.make_array_from_process_local_data(
+            sharding, np.asarray(a)), data)

@@ -111,10 +111,13 @@ class TrainStep:
                 jax.device_put(opt_state, self.replicated))
 
     def put_batch(self, x, y):
-        import jax
+        """Place a batch under the data-axis sharding.  Multi-controller:
+        x/y are this host's LOCAL rows and the global array is assembled
+        across processes (see parallel.distributed.put_sharded)."""
+        from sparkdl_tpu.parallel.distributed import put_sharded
 
-        return (jax.device_put(x, self.batch_sharded),
-                jax.device_put(y, self.batch_sharded))
+        return (put_sharded(self.batch_sharded, x),
+                put_sharded(self.batch_sharded, y))
 
     def __call__(self, params, opt_state, x, y):
         return self.step_fn(params, opt_state, x, y)
@@ -266,7 +269,14 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         batch_size += dp - batch_size % dp
         logger.info("global batch rounded up to %d (multiple of %d-way "
                     "data axis)", batch_size, dp)
-    batch_size = min(batch_size, max(dp, (x.shape[0] // dp) * dp))
+    pc = jax.process_count()
+    if pc > 1:
+        # Multi-controller: (x, y) are THIS host's shard (see
+        # distributed.shard_files); each host iterates local batches of
+        # global_batch/pc rows and put_batch assembles the global array.
+        batch_size = max(dp // pc, batch_size // pc)
+    else:
+        batch_size = min(batch_size, max(dp, (x.shape[0] // dp) * dp))
 
     step = make_train_step(predict_fn, loss, optimizer, mesh=mesh)
     opt_state = optimizer.init(params)
