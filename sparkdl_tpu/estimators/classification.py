@@ -146,6 +146,15 @@ class LogisticRegressionModel(Model, _HasClassifierCols):
         self.weights = weights
         self.numClasses = numClasses
 
+    def _persist(self, path):
+        return ({"numClasses": int(self.numClasses)},
+                {"weights": self.weights}, {})
+
+    @classmethod
+    def _restore(cls, extra, pytree, pickles, path):
+        return cls(weights=pytree["weights"],
+                   numClasses=int(extra["numClasses"]))
+
     def _transform(self, dataset):
         x = dataset.column_to_numpy(self.getFeaturesCol()).astype(np.float32)
         logits = x @ self.weights["w"] + self.weights["b"]

@@ -165,6 +165,11 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                    outputCol=self.getOutputCol(),
                    imageLoader=self.getImageLoader(),
                    batchSize=self.getBatchSize())
+        # Keras-backed estimators record the source file so persistence can
+        # rebuild the model fn without pickling keras closures.
+        if self.hasParam("modelFile") and self.isSet(
+                self.getParam("modelFile")):
+            model.modelFile = self.getOrDefault(self.getParam("modelFile"))
         return model
 
     def _fit(self, dataset) -> "ImageFileModel":
@@ -198,9 +203,51 @@ class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
         if modelFunction is not None:
             self._set(modelFunction=modelFunction)
         self.trainLosses = list(trainLosses or [])
+        self.modelFile: Optional[str] = None
 
     def getModelFunction(self):
         return self.getOrDefault(self.modelFunction)
+
+    def _persist(self, path):
+        import jax
+
+        mf = self.getModelFunction()
+        extra = {"trainLosses": [float(l) for l in self.trainLosses]}
+        pickles = {}
+        if self.modelFile:
+            extra["modelFile"] = self.modelFile
+            extra["modelFunction"] = "from-modelFile"
+        else:
+            pickles["modelFunction"] = {
+                "fn": mf.fn,
+                "input_names": list(mf.input_names),
+                "output_names": list(mf.output_names),
+            }
+        if self.isSet(self.getParam("imageLoader")):
+            pickles["imageLoader"] = self.getImageLoader()
+        host_vars = jax.tree_util.tree_map(np.asarray, mf.variables)
+        return extra, {"variables": host_vars}, pickles
+
+    @classmethod
+    def _restore(cls, extra, pytree, pickles, path):
+        from sparkdl_tpu.graph.function import ModelFunction
+
+        variables = pytree["variables"]
+        if "modelFile" in extra:
+            base = ModelFunction.from_keras(extra["modelFile"])
+            mf = ModelFunction(fn=base.fn, variables=variables,
+                               input_names=base.input_names,
+                               output_names=base.output_names)
+        else:
+            p = pickles["modelFunction"]
+            mf = ModelFunction(fn=p["fn"], variables=variables,
+                               input_names=tuple(p["input_names"]),
+                               output_names=tuple(p["output_names"]))
+        model = cls(modelFunction=mf, trainLosses=extra.get("trainLosses"))
+        model.modelFile = extra.get("modelFile")
+        if "imageLoader" in pickles:
+            model._set(imageLoader=pickles["imageLoader"])
+        return model
 
     def _transform(self, dataset):
         from sparkdl_tpu.transformers.image_file import ImageFileTransformer

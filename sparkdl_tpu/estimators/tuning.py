@@ -76,6 +76,24 @@ class CrossValidatorModel(Model):
     def _transform(self, dataset):
         return self.bestModel.transform(dataset)
 
+    def _persist(self, path):
+        from sparkdl_tpu import persistence
+
+        names = persistence.save_nested([self.bestModel], path)
+        return ({"bestModel": names[0],
+                 "avgMetrics": [float(m) for m in self.avgMetrics]},
+                None, {})
+
+    @classmethod
+    def _restore(cls, extra, pytree, pickles, path):
+        import os
+
+        from sparkdl_tpu import persistence
+
+        best = persistence.load_stage(
+            os.path.join(path, "stages", extra["bestModel"]))
+        return cls(best, extra.get("avgMetrics", []))
+
 
 class CrossValidator(Estimator):
     """K-fold model selection over a param grid.

@@ -255,6 +255,34 @@ class Params:
                 that._paramMap[p] = p.typeConverter(v)
         return that
 
+    # -- persistence (Spark ML writable/readable contract) ------------------
+    def save(self, path: str, overwrite: bool = False) -> str:
+        """Write this stage to ``path``; see sparkdl_tpu.persistence."""
+        from sparkdl_tpu import persistence
+
+        return persistence.save_stage(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "Params":
+        from sparkdl_tpu import persistence
+
+        stage = persistence.load_stage(path)
+        if not isinstance(stage, cls):
+            raise TypeError(
+                f"{path} holds a {type(stage).__name__}, not a {cls.__name__}")
+        return stage
+
+    def _persist(self, path: str):
+        """Hook: (extra metadata dict, variables pytree or None, pickles
+        dict).  The default persists nothing beyond JSON-able params."""
+        return {}, None, {}
+
+    @classmethod
+    def _restore(cls, extra: Dict, pytree, pickles: Dict, path: str):
+        """Hook: rebuild an instance from the persisted pieces (params are
+        re-applied by the caller afterwards)."""
+        return cls()
+
     def explainParam(self, param) -> str:
         p = self._resolveParam(param)
         value = "undefined"

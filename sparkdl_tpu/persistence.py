@@ -1,0 +1,198 @@
+"""Stage persistence: save/load for pipeline stages and fitted models.
+
+The reference's stages inherit Spark ML's writable/readable contract
+(``stage.save(path)`` / ``Stage.load(path)``); round 1 only had raw pytree
+checkpointing.  Layout per stage directory:
+
+  <path>/metadata.json   — {class, uid, params (JSON-able), extra, version}
+  <path>/variables/      — orbax checkpoint (model pytrees), when present
+  <path>/payload.pkl     — pickled callables (loaders/fns), when present
+  <path>/stages/<k>_*/   — nested stages (PipelineModel, CrossValidatorModel)
+
+Stages customize via two hooks:
+
+  ``_persist(self) -> (extra: dict, pytree | None, pickles: dict)``
+  ``cls._restore(cls, extra, pytree, pickles) -> stage``  (classmethod)
+
+The default implementation persists all explicitly-set JSON-able params and
+refuses (loudly) to silently drop non-serializable ones a subclass didn't
+handle.  Callables go through pickle — module-level functions round-trip;
+lambdas/closures fail at SAVE time with a clear error, matching Spark's
+behavior of failing writes for non-serializable stage state.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_FORMAT_VERSION = 1
+
+
+def _is_jsonable(v) -> bool:
+    if isinstance(v, (str, int, float, bool, type(None))):
+        return True
+    if isinstance(v, (list, tuple)):
+        return all(_is_jsonable(i) for i in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _is_jsonable(val)
+                   for k, val in v.items())
+    return False
+
+
+def save_stage(stage, path: str, overwrite: bool = False) -> str:
+    """Write ``stage`` under ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"{path} exists; pass overwrite=True to replace it")
+        shutil.rmtree(path)
+    os.makedirs(path)
+
+    params: Dict[str, Any] = {}
+    unsupported = []
+    for p in getattr(stage, "params", []):
+        if not stage.isSet(p):
+            continue
+        value = stage.getOrDefault(p)
+        if _is_jsonable(value):
+            params[p.name] = value
+        else:
+            unsupported.append(p.name)
+
+    extra, pytree, pickles = stage._persist(path)
+    leftover = [n for n in unsupported
+                if n not in extra and n not in pickles]
+    if leftover:
+        raise ValueError(
+            f"{type(stage).__name__} cannot persist params {leftover} "
+            f"(not JSON-serializable and not handled by the stage's "
+            f"_persist hook)")
+
+    meta = {
+        "class": f"{type(stage).__module__}.{type(stage).__qualname__}",
+        "uid": getattr(stage, "uid", None),
+        "version": _FORMAT_VERSION,
+        "params": params,
+        "extra": extra,
+        "has_variables": pytree is not None,
+        "pickles": sorted(pickles),
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+    if pytree is not None:
+        from sparkdl_tpu.checkpoint import save_pytree
+
+        save_pytree(os.path.join(path, "variables"), pytree)
+    if pickles:
+        try:
+            blob = pickle.dumps(pickles)
+        except Exception as e:
+            raise ValueError(
+                f"{type(stage).__name__} has non-picklable state "
+                f"({sorted(pickles)}): {e}. Use module-level functions "
+                f"instead of lambdas/closures for loaders and model fns, "
+                f"or reconstruct them after load") from e
+        with open(os.path.join(path, "payload.pkl"), "wb") as f:
+            f.write(blob)
+    return path
+
+
+def load_stage(path: str):
+    """Read a stage previously written by :func:`save_stage`."""
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    module_name, _, qualname = meta["class"].rpartition(".")
+    cls = importlib.import_module(module_name)
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+
+    pytree = None
+    if meta.get("has_variables"):
+        from sparkdl_tpu.checkpoint import restore_pytree
+
+        pytree = restore_pytree(os.path.join(path, "variables"))
+    pickles: Dict[str, Any] = {}
+    pkl_path = os.path.join(path, "payload.pkl")
+    if os.path.isfile(pkl_path):
+        with open(pkl_path, "rb") as f:
+            pickles = pickle.load(f)
+
+    stage = cls._restore(meta.get("extra", {}), pytree, pickles, path)
+    if meta.get("params"):
+        stage._set(**meta["params"])
+    return stage
+
+
+class PersistableModelFunctionMixin:
+    """Persistence for stages holding a ``modelFunction`` param (and an
+    optional ``imageLoader``): variables go to orbax, the fn through pickle
+    (module-level fns only).  Stages with a set ``modelFile`` skip pickling
+    the fn — it is rebuilt from the keras file on load."""
+
+    def _persist(self, path: str):
+        extra: Dict[str, Any] = {}
+        pickles: Dict[str, Any] = {}
+        pytree = None
+        has_model_file = (self.hasParam("modelFile")
+                          and self.isSet(self.getParam("modelFile")))
+        if self.isSet(self.getParam("modelFunction")):
+            mf = self.getModelFunction()
+            pytree = {"variables": mf.variables}
+            if has_model_file:
+                extra["modelFunction"] = "from-modelFile"
+            else:
+                pickles["modelFunction"] = {
+                    "fn": mf.fn,
+                    "input_names": list(mf.input_names),
+                    "output_names": list(mf.output_names),
+                }
+        if (self.hasParam("imageLoader")
+                and self.isSet(self.getParam("imageLoader"))):
+            pickles["imageLoader"] = self.getImageLoader()
+        return extra, pytree, pickles
+
+    @classmethod
+    def _restore(cls, extra: Dict, pytree, pickles: Dict, path: str):
+        stage = cls()
+        mfp = pickles.get("modelFunction")
+        if mfp is not None:
+            from sparkdl_tpu.graph.function import ModelFunction
+
+            stage._set(modelFunction=ModelFunction(
+                fn=mfp["fn"], variables=pytree["variables"],
+                input_names=tuple(mfp["input_names"]),
+                output_names=tuple(mfp["output_names"])))
+        if "imageLoader" in pickles:
+            stage._set(imageLoader=pickles["imageLoader"])
+        return stage
+
+
+# -- nested-stage helpers (PipelineModel / CrossValidatorModel) -------------
+
+def save_nested(stages, path: str) -> list:
+    """Write ``stages`` under ``<path>/stages/<idx>_<Class>/``; returns the
+    relative dir names in order."""
+    names = []
+    base = os.path.join(path, "stages")
+    os.makedirs(base, exist_ok=True)
+    for i, stage in enumerate(stages):
+        name = f"{i:03d}_{type(stage).__name__}"
+        save_stage(stage, os.path.join(base, name))
+        names.append(name)
+    return names
+
+
+def load_nested(path: str, names) -> list:
+    return [load_stage(os.path.join(path, "stages", n)) for n in names]

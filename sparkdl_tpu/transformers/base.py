@@ -97,6 +97,17 @@ class PipelineModel(Model):
             batches = stage.transformStream(batches)
         yield from batches
 
+    def _persist(self, path):
+        from sparkdl_tpu import persistence
+
+        return {"stages": persistence.save_nested(self.stages, path)}, None, {}
+
+    @classmethod
+    def _restore(cls, extra, pytree, pickles, path):
+        from sparkdl_tpu import persistence
+
+        return cls(persistence.load_nested(path, extra["stages"]))
+
 
 class Pipeline(Estimator):
     """Sequential pipeline of stages (pyspark.ml.Pipeline semantics: fitting

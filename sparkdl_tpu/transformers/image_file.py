@@ -20,13 +20,15 @@ from sparkdl_tpu.param.params import Param, keyword_only
 from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
                                       HasOutputCol)
 from sparkdl_tpu.parallel.engine import get_cached_engine
+from sparkdl_tpu.persistence import PersistableModelFunctionMixin
 from sparkdl_tpu.transformers.base import Transformer
 from sparkdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
-class ImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
+class ImageFileTransformer(PersistableModelFunctionMixin, Transformer,
+                           HasInputCol, HasOutputCol,
                            HasBatchSize, CanLoadImage):
     """Apply a ModelFunction to images loaded from a URI column via the
     user's ``imageLoader``.  Rows whose loader raises or returns None become

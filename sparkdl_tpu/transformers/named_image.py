@@ -28,6 +28,7 @@ from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
 from sparkdl_tpu.param.shared import (HasBatchSize, HasInputCol, HasModelName,
                                       HasOutputCol, HasOutputMode, HasTopK)
 from sparkdl_tpu.parallel.engine import InferenceEngine, get_cached_engine
+from sparkdl_tpu.persistence import PersistableModelFunctionMixin
 from sparkdl_tpu.transformers.base import Transformer
 from sparkdl_tpu.utils.logging import get_logger
 
@@ -278,7 +279,8 @@ class DeepImagePredictor(_NamedImageTransformer):
         return dataset.withColumn(out_col, pa.array(values, type=pred_type))
 
 
-class TFImageTransformer(_ImageInputStage, HasOutputMode):
+class TFImageTransformer(PersistableModelFunctionMixin, _ImageInputStage,
+                         HasOutputMode):
     """Arbitrary model over the image column.
 
     Counterpart of the reference's ``TFImageTransformer`` (C4): where that

@@ -27,6 +27,7 @@ from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, keyword_only
 from sparkdl_tpu.param.shared import HasBatchSize, HasInputCol, HasOutputCol
 from sparkdl_tpu.parallel.engine import get_cached_engine
+from sparkdl_tpu.persistence import PersistableModelFunctionMixin
 from sparkdl_tpu.transformers.base import Transformer
 
 
@@ -37,7 +38,8 @@ def _rows_to_list_array(mat: np.ndarray) -> pa.Array:
                     type=pa.list_(pa.float32()))
 
 
-class ModelTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+class ModelTransformer(PersistableModelFunctionMixin, Transformer,
+                       HasInputCol, HasOutputCol, HasBatchSize):
     """Apply a ModelFunction to an array column (one row = one example)."""
 
     modelFunction = Param(

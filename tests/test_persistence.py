@@ -1,0 +1,164 @@
+"""Stage persistence round-trips (VERDICT round 1, Missing #6): the Spark
+ML writable/readable contract — fit -> save -> load -> identical transform
+output."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.estimators import (ImageFileEstimator,
+                                    KerasImageFileEstimator,
+                                    LogisticRegression)
+from sparkdl_tpu.estimators.classification import LogisticRegressionModel
+from sparkdl_tpu.frame import DataFrame
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.transformers import (DeepImageFeaturizer, PipelineModel,
+                                      TFImageTransformer)
+from sparkdl_tpu.transformers.image_file import ImageFileTransformer
+
+
+# module-level (picklable) model fn + loader
+
+def _linear_fn(v, x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x).reshape(x.shape[0], -1) @ v["w"]
+
+
+def _loader8(uri):
+    from PIL import Image
+
+    img = Image.open(uri).convert("RGB").resize((8, 8))
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def test_zoo_transformer_roundtrip(tmp_path):
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=16)
+    p = str(tmp_path / "featurizer")
+    ft.save(p)
+    loaded = DeepImageFeaturizer.load(p)
+    assert loaded.getModelName() == "ResNet50"
+    assert loaded.getBatchSize() == 16
+    assert loaded.getInputCol() == "image"
+    # overwrite contract
+    with pytest.raises(FileExistsError):
+        ft.save(p)
+    ft.save(p, overwrite=True)
+
+
+def test_logistic_regression_model_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(60, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    df = DataFrame({"features": [list(map(float, r)) for r in x],
+                    "label": y})
+    model = LogisticRegression(maxIter=20, learningRate=0.2).fit(df)
+    p = str(tmp_path / "lr")
+    model.save(p)
+    loaded = LogisticRegressionModel.load(p)
+    a = model.transform(df).collect()
+    b = loaded.transform(df).collect()
+    for ra, rb in zip(a, b):
+        assert ra["prediction"] == rb["prediction"]
+        np.testing.assert_allclose(ra["probability"], rb["probability"],
+                                   rtol=1e-6)
+
+
+def test_image_file_model_roundtrip(tmp_path, fixture_images):
+    paths = fixture_images["paths"] * 4
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+              for i in range(len(paths))]
+    df = DataFrame({"uri": paths, "label": labels})
+    rng = np.random.default_rng(1)
+    mf = ModelFunction(fn=_linear_fn, variables={
+        "w": rng.normal(0, 0.01, (8 * 8 * 3, 2)).astype(np.float32)})
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader8, optimizer="sgd",
+        loss="mse", fitParams={"epochs": 2}, batchSize=8)
+    model = est.fit(df)
+    p = str(tmp_path / "model")
+    model.save(p)
+    from sparkdl_tpu.estimators.image_file_estimator import ImageFileModel
+
+    loaded = ImageFileModel.load(p)
+    assert loaded.trainLosses == pytest.approx(model.trainLosses)
+    a = model.transform(df).collect()
+    b = loaded.transform(df).collect()
+    for ra, rb in zip(a, b):
+        np.testing.assert_allclose(ra["preds"], rb["preds"], rtol=1e-6)
+
+
+def test_keras_image_file_model_roundtrip(tmp_path, fixture_images):
+    import keras
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((8, 8, 3)),
+        layers.Conv2D(2, 3, padding="same"),
+        layers.GlobalAveragePooling2D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    kpath = str(tmp_path / "tiny.keras")
+    km.save(kpath)
+    paths = fixture_images["paths"] * 4
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+              for i in range(len(paths))]
+    df = DataFrame({"uri": paths, "label": labels})
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFile=kpath, imageLoader=_loader8, kerasOptimizer="sgd",
+        kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 1}, batchSize=8)
+    model = est.fit(df)
+    p = str(tmp_path / "fitted")
+    model.save(p)  # must NOT try to pickle keras closures
+    from sparkdl_tpu.estimators.image_file_estimator import ImageFileModel
+
+    loaded = ImageFileModel.load(p)
+    a = model.transform(df).collect()
+    b = loaded.transform(df).collect()
+    for ra, rb in zip(a, b):
+        np.testing.assert_allclose(ra["preds"], rb["preds"], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_pipeline_model_roundtrip(tmp_path, fixture_images):
+    from sparkdl_tpu.image.io import readImages
+
+    df = readImages(fixture_images["dir"])
+    mf = ModelFunction(fn=_linear_fn, variables={
+        "w": np.full((16 * 16 * 3, 4), 0.01, np.float32)})
+    t = TFImageTransformer(inputCol="image", outputCol="feats",
+                           modelFunction=mf, inputSize=[16, 16],
+                           outputMode="vector", batchSize=8)
+    pm = PipelineModel([t])
+    p = str(tmp_path / "pipe")
+    pm.save(p)
+    loaded = PipelineModel.load(p)
+    a = pm.transform(df).collect()
+    b = loaded.transform(df).collect()
+    for ra, rb in zip(a, b):
+        if ra["feats"] is None:
+            assert rb["feats"] is None
+        else:
+            np.testing.assert_allclose(ra["feats"], rb["feats"], rtol=1e-6)
+
+
+def test_lambda_model_fn_fails_loudly(tmp_path):
+    mf = ModelFunction(fn=lambda v, x: x, variables={})
+    t = ImageFileTransformer(inputCol="uri", outputCol="out",
+                             modelFunction=mf, imageLoader=_loader8)
+    with pytest.raises(ValueError, match="non-picklable"):
+        t.save(str(tmp_path / "bad"))
+
+
+def test_load_type_check(tmp_path):
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                             modelName="VGG16")
+    p = str(tmp_path / "ft")
+    ft.save(p)
+    with pytest.raises(TypeError, match="not a"):
+        LogisticRegressionModel.load(p)
