@@ -69,6 +69,15 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         "'checkpoint_dir': str, 'checkpoint_every_epochs': int}",
         typeConverter=TypeConverters.toDict)
 
+    trainBatchStats = Param(
+        "undefined", "trainBatchStats",
+        "update BatchNorm statistics during the fit (Keras fit semantics; "
+        "stats reductions have global-batch semantics via the SPMD psum). "
+        "Default False: stats stay frozen (inference-mode fine-tuning). "
+        "Requires a model with a train-mode apply "
+        "(ModelFunction.train_fn, e.g. from_flax with batch_stats).",
+        typeConverter=TypeConverters.toBoolean)
+
     @keyword_only
     def __init__(self, inputCol: Optional[str] = None,
                  outputCol: Optional[str] = None,
@@ -78,10 +87,12 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                  optimizer=None,
                  loss: Optional[Any] = None,
                  fitParams: Optional[Dict] = None,
-                 batchSize: Optional[int] = None):
+                 batchSize: Optional[int] = None,
+                 trainBatchStats: Optional[bool] = None):
         super().__init__()
         self._setDefault(batchSize=32, fitParams={},
-                         loss="categorical_crossentropy")
+                         loss="categorical_crossentropy",
+                         trainBatchStats=False)
         self._set(**self._input_kwargs)
 
     @keyword_only
@@ -93,8 +104,12 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                   optimizer=None,
                   loss: Optional[Any] = None,
                   fitParams: Optional[Dict] = None,
-                  batchSize: Optional[int] = None):
+                  batchSize: Optional[int] = None,
+                  trainBatchStats: Optional[bool] = None):
         return self._set(**self._input_kwargs)
+
+    def getTrainBatchStats(self) -> bool:
+        return bool(self.getOrDefault(self.trainBatchStats))
 
     # -- param access ------------------------------------------------------
     def getModelFunction(self):
@@ -144,8 +159,7 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
     def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray) -> "ImageFileModel":
         mf = self.getModelFunction()
         fp = self.getFitParams()
-        fitted, losses = fit_data_parallel(
-            mf.fn, mf.variables, x, y,
+        common = dict(
             optimizer=self.getOptimizer(),
             loss=self.getLoss(),
             batch_size=self.getBatchSize(),
@@ -154,9 +168,48 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
             seed=int(fp.get("seed", 0)),
             checkpoint_dir=fp.get("checkpoint_dir"),
             checkpoint_every_epochs=int(fp.get("checkpoint_every_epochs", 1)))
+        has_stats = (isinstance(mf.variables, dict)
+                     and "batch_stats" in mf.variables)
+        if self.getTrainBatchStats():
+            if mf.train_fn is None or not has_stats:
+                raise ValueError(
+                    "trainBatchStats=True requires a model with a "
+                    "train-mode apply and batch_stats collections "
+                    "(e.g. ModelFunction.from_flax on a BatchNorm module)")
+            fitted, losses = fit_data_parallel(
+                mf.fn, mf.variables["params"], x, y,
+                train_fn=mf.train_fn,
+                stats=mf.variables["batch_stats"], **common)
+            new_vars = dict(mf.variables)
+            new_vars.update(fitted)  # params + batch_stats
+        elif has_stats:
+            # Default: BN statistics stay FROZEN structurally — only the
+            # params collection trains (inference-mode fine-tuning; the
+            # divergence from Keras fit is now a param, not just a note).
+            predict = getattr(mf, "_frozen_stats_predict", None)
+            if predict is None:
+                frozen = {k: v for k, v in mf.variables.items()
+                          if k != "params"}
+
+                def predict(p, xb):
+                    return mf.fn({**frozen, "params": p}, xb)
+
+                # cache on the ModelFunction so repeated fits (param maps,
+                # folds) reuse one closure -> one compiled step
+                mf._frozen_stats_predict = predict
+            fitted, losses = fit_data_parallel(
+                predict, mf.variables["params"], x, y, **common)
+            new_vars = {k: v for k, v in mf.variables.items()
+                        if k != "params"}
+            new_vars["params"] = fitted
+        else:
+            fitted, losses = fit_data_parallel(
+                mf.fn, mf.variables, x, y, **common)
+            new_vars = fitted
         from sparkdl_tpu.graph.function import ModelFunction
 
-        fitted_mf = ModelFunction(fn=mf.fn, variables=fitted,
+        fitted_mf = ModelFunction(fn=mf.fn, variables=new_vars,
+                                  train_fn=mf.train_fn,
                                   input_names=mf.input_names,
                                   output_names=mf.output_names)
         model = ImageFileModel(modelFunction=fitted_mf,
@@ -287,7 +340,8 @@ class KerasImageFileEstimator(ImageFileEstimator):
                  batchSize: Optional[int] = None):
         Estimator.__init__(self)
         self._setDefault(batchSize=32, fitParams={},
-                         loss="categorical_crossentropy")
+                         loss="categorical_crossentropy",
+                         trainBatchStats=False)
         kw = dict(self._input_kwargs)
         # Map keras-named params onto the native ones.
         if kw.get("kerasOptimizer") is not None:

@@ -29,6 +29,10 @@ class ModelFunction:
     variables: Any = field(default_factory=dict)
     input_names: Sequence[str] = ("input",)
     output_names: Sequence[str] = ("output",)
+    # Optional train-mode apply: ``train_fn(variables, x) ->
+    # (pred, new_batch_stats)`` — set for models with BatchNorm whose
+    # statistics can update during fine-tuning (estimator trainBatchStats).
+    train_fn: Optional[Callable[[Any, Any], Any]] = None
 
     def __call__(self, x):
         return self.fn(self.variables, x)
@@ -45,13 +49,24 @@ class ModelFunction:
     def from_flax(cls, module, variables, *,
                   method_kwargs: Optional[dict] = None,
                   input_names=("input",), output_names=("output",)):
-        """Bind a flax module's apply (inference mode by default)."""
+        """Bind a flax module's apply (inference mode by default).  Modules
+        carrying ``batch_stats`` also get a train-mode apply so BatchNorm
+        statistics can update during estimator fits (trainBatchStats)."""
         kw = dict(method_kwargs or {})
 
         def fn(v, x):
             return module.apply(v, x, **kw)
 
-        return cls(fn=fn, variables=variables,
+        train_fn = None
+        if isinstance(variables, dict) and "batch_stats" in variables:
+            tkw = {k: v for k, v in kw.items() if k != "train"}
+
+            def train_fn(v, x):
+                pred, mutated = module.apply(
+                    v, x, train=True, mutable=["batch_stats"], **tkw)
+                return pred, mutated["batch_stats"]
+
+        return cls(fn=fn, variables=variables, train_fn=train_fn,
                    input_names=input_names, output_names=output_names)
 
     @classmethod

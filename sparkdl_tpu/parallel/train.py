@@ -193,6 +193,88 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
     return result
 
 
+@dataclass
+class TrainStepWithStats:
+    """Compiled data-parallel step that ALSO updates BatchNorm statistics:
+    (params, stats, opt_state, x, y) -> (params, stats, opt_state, loss).
+
+    Under the sharded jit the batch-mean/variance reductions have GLOBAL
+    semantics — XLA's SPMD partitioner inserts the cross-chip psum — so the
+    updated stats match a single-device run over the whole global batch
+    (the Keras ``fit`` behavior the reference estimator had, C15)."""
+
+    step_fn: Callable
+    mesh: Any
+    replicated: Any
+    batch_sharded: Any
+
+    def put_state(self, params, stats, opt_state):
+        import jax
+
+        return (jax.device_put(params, self.replicated),
+                jax.device_put(stats, self.replicated),
+                jax.device_put(opt_state, self.replicated))
+
+    def put_batch(self, x, y):
+        from sparkdl_tpu.parallel.distributed import put_sharded
+
+        return (put_sharded(self.batch_sharded, x),
+                put_sharded(self.batch_sharded, y))
+
+    def __call__(self, params, stats, opt_state, x, y):
+        return self.step_fn(params, stats, opt_state, x, y)
+
+
+def make_train_step_with_stats(train_fn: Callable, loss, optimizer,
+                               mesh=None, cache: bool = True
+                               ) -> TrainStepWithStats:
+    """Like :func:`make_train_step` but for models whose
+    ``train_fn({"params":..., "batch_stats":...}, x) -> (pred, new_stats)``
+    updates BatchNorm statistics (ModelFunction.train_fn)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    key = ("stats", id(train_fn),
+           loss if isinstance(loss, str) else id(loss),
+           id(optimizer), _mesh_key(mesh))
+    if cache:
+        cached = _STEP_CACHE.get(key)
+        if cached is not None:
+            return cached
+    replicated = mesh_lib.replicated_sharding(mesh)
+    batch_sharded = mesh_lib.batch_sharding(mesh)
+    loss_fn = resolve_loss(loss)
+
+    def scalar_loss(params, stats, x, y):
+        pred, new_stats = train_fn(
+            {"params": params, "batch_stats": stats}, x)
+        return jnp.mean(loss_fn(pred, y)), new_stats
+
+    def step(params, stats, opt_state, x, y):
+        (lval, new_stats), grads = jax.value_and_grad(
+            scalar_loss, has_aux=True)(params, stats, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, lval
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(replicated, replicated, replicated,
+                      batch_sharded, batch_sharded),
+        out_shardings=(replicated, replicated, replicated, replicated),
+        donate_argnums=(0, 1, 2))
+    result = TrainStepWithStats(step_fn=step_fn, mesh=mesh,
+                                replicated=replicated,
+                                batch_sharded=batch_sharded)
+    if cache:
+        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+        _STEP_CACHE[key] = result
+    return result
+
+
 _OPT_INSTANCES: Dict[int, Any] = {}
 _DEFAULT_OPTIMIZER = None
 
@@ -248,12 +330,19 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
                       mesh=None,
                       checkpoint_dir: Optional[str] = None,
                       checkpoint_every_epochs: int = 1,
-                      metrics: Optional[Metrics] = None) -> Tuple[Any, list]:
+                      metrics: Optional[Metrics] = None,
+                      train_fn: Optional[Callable] = None,
+                      stats: Optional[Any] = None) -> Tuple[Any, list]:
     """Fit ``params`` on (x, y) with batch-sharded steps over the mesh.
 
     Returns (fitted params on host, per-epoch mean losses).  The analog of
     the reference estimator's executor-side ``model.fit`` hot loop
     (``keras_image_file_estimator.py``), distributed instead of single-node.
+
+    With ``train_fn`` + ``stats`` (BatchNorm statistics pytree), the step
+    also updates batch stats with global-batch semantics (estimator
+    ``trainBatchStats=True``) and the fitted value returned is
+    ``{"params": ..., "batch_stats": ...}``.
 
     With ``checkpoint_dir``, params+optimizer state are orbax-checkpointed
     every ``checkpoint_every_epochs`` epochs and an interrupted fit resumes
@@ -278,8 +367,20 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
     else:
         batch_size = min(batch_size, max(dp, (x.shape[0] // dp) * dp))
 
-    step = make_train_step(predict_fn, loss, optimizer, mesh=mesh)
+    with_stats = train_fn is not None
+    if with_stats:
+        step = make_train_step_with_stats(train_fn, loss, optimizer,
+                                          mesh=mesh)
+        stats = stats if stats is not None else {}
+    else:
+        step = make_train_step(predict_fn, loss, optimizer, mesh=mesh)
     opt_state = optimizer.init(params)
+
+    def _ckpt_state(p, s, o):
+        state = {"params": p, "opt_state": o}
+        if with_stats:
+            state["batch_stats"] = s
+        return state
 
     start_epoch = 0
     ckptr = None
@@ -288,12 +389,17 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
 
         ckptr = TrainCheckpointer(checkpoint_dir, checkpoint_every_epochs)
         resumed = ckptr.restore_latest(
-            template={"params": params, "opt_state": opt_state})
+            template=_ckpt_state(params, stats, opt_state))
         if resumed is not None:
             start_epoch, state = resumed
             params, opt_state = state["params"], state["opt_state"]
+            if with_stats:
+                stats = state["batch_stats"]
 
-    params, opt_state = step.put_state(params, opt_state)
+    if with_stats:
+        params, stats, opt_state = step.put_state(params, stats, opt_state)
+    else:
+        params, opt_state = step.put_state(params, opt_state)
 
     metrics = metrics if metrics is not None else Metrics()
     epoch_losses = []
@@ -301,7 +407,11 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         losses = []
         for bx, by in _epoch_batches(x, y, batch_size, epoch, shuffle, seed):
             bx_d, by_d = step.put_batch(bx, by)
-            params, opt_state, lval = step(params, opt_state, bx_d, by_d)
+            if with_stats:
+                params, stats, opt_state, lval = step(
+                    params, stats, opt_state, bx_d, by_d)
+            else:
+                params, opt_state, lval = step(params, opt_state, bx_d, by_d)
             losses.append(lval)
         mean = float(np.mean([float(l) for l in losses]))
         epoch_losses.append(mean)
@@ -312,7 +422,11 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
             # Gathering does not invalidate the device arrays; the next
             # step keeps using them (and donates them as usual).
             host_state = jax.tree_util.tree_map(
-                np.asarray, {"params": params, "opt_state": opt_state})
+                np.asarray, _ckpt_state(params, stats, opt_state))
             ckptr.maybe_save(epoch + 1, host_state)
+    if with_stats:
+        return (jax.tree_util.tree_map(
+            np.asarray, {"params": params, "batch_stats": stats}),
+            epoch_losses)
     params = jax.tree_util.tree_map(np.asarray, params)
     return params, epoch_losses

@@ -272,3 +272,78 @@ def test_epoch_batches_modular_wrap_tiny_dataset():
     assert bx.shape == (8, 1) and by.shape == (8,)
     # every original sample still present
     assert set(np.unique(bx[:, 0])) == {0.0, 1.0, 2.0}
+
+
+# ---------------------------------------------------------------------------
+# trainBatchStats (BN semantics)
+
+
+def _bn_model_function(seed=0):
+    """Tiny flax conv+BN model wrapped as a ModelFunction (with train_fn)."""
+    import jax
+    from flax import linen as nn
+
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), name="conv")(x)
+            x = nn.BatchNorm(use_running_average=not train, name="bn")(x)
+            x = x.mean(axis=(1, 2))
+            return nn.softmax(nn.Dense(2, name="head")(x))
+
+    module = BNNet()
+    variables = jax.jit(
+        lambda r, xb: module.init(r, xb, train=False)
+    )(jax.random.PRNGKey(seed), np.zeros((1, 8, 8, 3), np.float32))
+    variables = jax.tree_util.tree_map(np.asarray, variables)
+    return ModelFunction.from_flax(
+        module, dict(variables), method_kwargs={"train": False})
+
+
+def test_train_batch_stats_updates_stats(uri_label_df):
+    mf = _bn_model_function()
+    before = np.asarray(mf.variables["batch_stats"]["bn"]["mean"]).copy()
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader, optimizer="sgd",
+        loss="categorical_crossentropy", fitParams={"epochs": 2},
+        batchSize=8, trainBatchStats=True)
+    model = est.fit(uri_label_df)
+    after = np.asarray(
+        model.getModelFunction().variables["batch_stats"]["bn"]["mean"])
+    assert not np.allclose(before, after), "BN stats did not update"
+    rows = model.transform(uri_label_df).collect()
+    assert all(abs(sum(r["preds"]) - 1.0) < 1e-3 for r in rows)
+
+
+def test_default_keeps_batch_stats_frozen(uri_label_df):
+    mf = _bn_model_function()
+    before = np.asarray(mf.variables["batch_stats"]["bn"]["mean"]).copy()
+    before_params = np.asarray(
+        mf.variables["params"]["head"]["kernel"]).copy()
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader, optimizer="sgd",
+        loss="categorical_crossentropy", fitParams={"epochs": 2},
+        batchSize=8)  # trainBatchStats defaults False
+    model = est.fit(uri_label_df)
+    fitted = model.getModelFunction().variables
+    np.testing.assert_array_equal(
+        before, np.asarray(fitted["batch_stats"]["bn"]["mean"]))
+    assert not np.allclose(
+        before_params, np.asarray(fitted["params"]["head"]["kernel"]))
+
+
+def test_train_batch_stats_requires_train_fn(uri_label_df):
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    mf = ModelFunction(fn=lambda v, x: x.reshape(x.shape[0], -1)[:, :2],
+                       variables={"w": np.zeros((1,), np.float32)})
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader,
+        loss="mse", trainBatchStats=True)
+    with pytest.raises(ValueError, match="trainBatchStats"):
+        est.fit(uri_label_df)
