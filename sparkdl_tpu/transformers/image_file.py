@@ -106,11 +106,21 @@ class ImageFileTransformer(PersistableModelFunctionMixin, Transformer,
         first = next(it, None)
         outs = []
         if first is not None:
+            import time
+
             # Engine (weight load + compile) only once a chunk proves
             # there's work to do.
             eng = get_cached_engine(self, self.getModelFunction(),
                                     device_batch_size=self.getBatchSize())
+            t0 = time.perf_counter()
             outs = list(eng.map_batches(chain([first], it)))
+            elapsed = time.perf_counter() - t0
+            k, ndev = len(valid_idx), eng.num_devices
+            ips = k / elapsed if elapsed > 0 else float("inf")
+            logger.info("%s: %d images in %.3fs — %.1f img/s "
+                        "(%.1f img/s/chip over %d devices)",
+                        type(self).__name__, k, elapsed, ips, ips / ndev,
+                        ndev)
         n = len(dataset)
         values: List[Optional[list]] = [None] * n
         if outs:

@@ -141,6 +141,8 @@ class _ImageInputStage(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
 
         from sparkdl_tpu.utils.prefetch import prefetch_iter
 
+        import time
+
         valid_idx: List[int] = []
         chunks = self._decoded_chunks(
             dataset, height, width, self._chunk_rows(), valid_idx, origins)
@@ -149,9 +151,16 @@ class _ImageInputStage(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
         if first is None:
             return None, valid_idx
         engine = engine_factory()
+        t0 = time.perf_counter()
         outs = list(engine.map_batches(chain([first], it)))
+        elapsed = time.perf_counter() - t0
         import jax
 
+        n, ndev = len(valid_idx), engine.num_devices
+        ips = n / elapsed if elapsed > 0 else float("inf")
+        logger.info("%s: %d images in %.3fs — %.1f img/s "
+                    "(%.1f img/s/chip over %d devices)",
+                    type(self).__name__, n, elapsed, ips, ips / ndev, ndev)
         out = jax.tree_util.tree_map(
             lambda *parts: np.concatenate(parts, axis=0), *outs)
         return out, valid_idx
