@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Test gate for sparkdl_tpu (SURVEY.md C18 equivalent of python/run-tests.sh).
+#
+# Runs the full suite on a virtual 8-device CPU mesh (the conftest sets
+# XLA_FLAGS/JAX_PLATFORMS); exits non-zero on any failure. Run this before
+# every snapshot/commit of substance — a red suite must never ship.
+#
+# Usage: ./run-tests.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python -m pytest tests/ -q --durations=10 "$@"

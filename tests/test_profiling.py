@@ -27,7 +27,10 @@ def test_metrics_profile_writes_trace(tmp_path):
     assert m.timings_s["profile"]
 
 
-def test_transformer_logs_throughput(caplog, fixture_images):
+def test_transformer_logs_throughput(fixture_images):
+    # The sparkdl_tpu logger sets propagate=False, so pytest's caplog (which
+    # captures via the root logger) never sees its records; attach a handler
+    # directly to the framework logger instead.
     import logging
 
     from sparkdl_tpu.graph.function import ModelFunction
@@ -39,10 +42,24 @@ def test_transformer_logs_throughput(caplog, fixture_images):
                        variables={})
     t = TFImageTransformer(inputCol="image", outputCol="o",
                            modelFunction=mf, inputSize=[8, 8], batchSize=8)
-    with caplog.at_level(logging.INFO, logger="sparkdl_tpu"):
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("sparkdl_tpu")
+    handler = _Capture(level=logging.INFO)
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
         t.transform(df)
-    assert any("img/s/chip" in r.message for r in caplog.records), (
-        [r.message for r in caplog.records])
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+    assert any("img/s/chip" in msg for msg in records), records
 
 
 def test_metrics_summary_and_timer():
