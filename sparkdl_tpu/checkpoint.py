@@ -20,6 +20,29 @@ from sparkdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _checkpointer():
+    """A StandardCheckpointer safe for our single-writer protocol.
+
+    Orbax's default save/finalize is a COLLECTIVE across all processes; the
+    framework gates checkpoint writes to process 0 (see
+    :class:`TrainCheckpointer`), so under multi-controller jax the
+    checkpointer must be process-local — ``active_processes={self}`` drops
+    the cross-process barriers that would otherwise deadlock a gated save.
+    State passed in is host numpy (gathered from replicated device arrays),
+    so no cross-process array shards are ever needed.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    pid = jax.process_index()
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=pid, active_processes={pid},
+            barrier_sync_key_prefix=f"sparkdl-p{pid}"))
+
+
 def save_pytree(path: str, tree: Any, *, force: bool = True) -> str:
     """Save a variables pytree to ``path`` (an orbax directory).
 
@@ -27,10 +50,8 @@ def save_pytree(path: str, tree: Any, *, force: bool = True) -> str:
     the tmp dir into place) on close, so a long-lived unclosed checkpointer
     can leave ``*.orbax-checkpoint-tmp`` dirs behind.
     """
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         ckptr.save(path, tree, force=force)
     return path
 
@@ -38,10 +59,8 @@ def save_pytree(path: str, tree: Any, *, force: bool = True) -> str:
 def restore_pytree(path: str, template: Optional[Any] = None) -> Any:
     """Restore a pytree; ``template`` (matching structure, e.g. abstract
     shapes) guides dtype/sharding restoration when given."""
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         if template is not None:
             import jax
 
@@ -73,10 +92,21 @@ class TrainCheckpointer:
         materializing device state to host so skipped epochs pay nothing."""
         return epoch % self.every_epochs == 0
 
+    @staticmethod
+    def is_writer() -> bool:
+        """Single-writer rule for multi-controller runs: params/opt_state
+        are replicated, so only process 0 writes — concurrent orbax tmp-dir
+        renames from several hosts race on shared storage and can corrupt
+        the checkpoint.  Non-writers skip the device->host gather too."""
+        import jax
+
+        return jax.process_index() == 0
+
     def maybe_save(self, epoch: int, state: Any) -> Optional[str]:
         """Save ``state`` (any pytree — e.g. {"params":..., "opt_state":...})
-        if the epoch hits the cadence; returns the path if saved."""
-        if not self.due(epoch):
+        if the epoch hits the cadence; returns the path if saved.  In a
+        multi-controller run only process 0 writes (see :meth:`is_writer`)."""
+        if not self.due(epoch) or not self.is_writer():
             return None
         path = self._path(epoch)
         save_pytree(path, {"state": state, "epoch": epoch})
@@ -100,10 +130,39 @@ class TrainCheckpointer:
 
     def restore_latest(self, template: Optional[Any] = None
                        ) -> Optional[Tuple[int, Any]]:
-        found = self.latest()
-        if found is None:
-            return None
-        epoch, path = found
+        import jax
+
+        if jax.process_count() > 1:
+            # Multi-controller resume must be CONSISTENT: only process 0
+            # writes (is_writer), so process 0's view of the directory is
+            # authoritative.  Barrier first (no host reads a checkpoint
+            # process 0 is still finalizing), then broadcast process 0's
+            # latest epoch — a host whose local view disagrees (e.g.
+            # checkpoint_dir on host-local disk) would otherwise resume at
+            # a different epoch and deadlock the collectives; that
+            # misconfiguration fails loudly here instead.
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sparkdl:ckpt:restore")
+            found = self.latest()
+            local_epoch = found[0] if found is not None else -1
+            epoch0 = int(multihost_utils.broadcast_one_to_all(
+                _np.asarray(local_epoch, _np.int64)))
+            if epoch0 < 0:
+                return None
+            path = self._path(epoch0)
+            if not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"process {jax.process_index()} cannot see checkpoint "
+                    f"{path} (process 0's latest). checkpoint_dir must be "
+                    f"on shared storage visible to every host")
+            epoch = epoch0
+        else:
+            found = self.latest()
+            if found is None:
+                return None
+            epoch, path = found
         tree = restore_pytree(
             path, {"state": template, "epoch": 0} if template is not None
             else None)

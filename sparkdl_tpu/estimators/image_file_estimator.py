@@ -271,11 +271,9 @@ class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
             extra["modelFile"] = self.modelFile
             extra["modelFunction"] = "from-modelFile"
         else:
-            pickles["modelFunction"] = {
-                "fn": mf.fn,
-                "input_names": list(mf.input_names),
-                "output_names": list(mf.output_names),
-            }
+            from sparkdl_tpu.persistence import modelfunction_payload
+
+            pickles["modelFunction"] = modelfunction_payload(mf)
         if self.isSet(self.getParam("imageLoader")):
             pickles["imageLoader"] = self.getImageLoader()
         host_vars = jax.tree_util.tree_map(np.asarray, mf.variables)
@@ -284,18 +282,18 @@ class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
     @classmethod
     def _restore(cls, extra, pytree, pickles, path):
         from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.persistence import modelfunction_from_payload
 
         variables = pytree["variables"]
         if "modelFile" in extra:
             base = ModelFunction.from_keras(extra["modelFile"])
             mf = ModelFunction(fn=base.fn, variables=variables,
+                               train_fn=base.train_fn,
                                input_names=base.input_names,
                                output_names=base.output_names)
         else:
-            p = pickles["modelFunction"]
-            mf = ModelFunction(fn=p["fn"], variables=variables,
-                               input_names=tuple(p["input_names"]),
-                               output_names=tuple(p["output_names"]))
+            mf = modelfunction_from_payload(pickles["modelFunction"],
+                                            variables)
         model = cls(modelFunction=mf, trainLosses=extra.get("trainLosses"))
         model.modelFile = extra.get("modelFile")
         if "imageLoader" in pickles:
@@ -308,14 +306,23 @@ class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,
         # One persistent transformer per fitted model: repeated transforms
         # (e.g. every CrossValidator evaluation) reuse its engine cache —
         # weights stay device-resident instead of re-uploading per call.
-        t = self.__dict__.get("_transformer")
-        if t is None:
+        # Keyed by the params it was built from: Params.copy() shallow-copies
+        # __dict__, so a copy with overridden outputCol (or a later set*)
+        # must NOT reuse a transformer built for the old columns.  Holding
+        # mf/loader in the cache entry keeps their ids from being recycled.
+        mf = self.getModelFunction()
+        loader = self.getImageLoader()
+        key = (self.getInputCol(), self.getOutputCol(), self.getBatchSize(),
+               id(mf), id(loader))
+        cached = self.__dict__.get("_transformer_cache")
+        if cached is not None and cached[0] == key:
+            t = cached[1]
+        else:
             t = ImageFileTransformer(
                 inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
-                modelFunction=self.getModelFunction(),
-                imageLoader=self.getImageLoader(),
+                modelFunction=mf, imageLoader=loader,
                 batchSize=self.getBatchSize())
-            self.__dict__["_transformer"] = t
+            self.__dict__["_transformer_cache"] = (key, t, mf, loader)
         return t.transform(dataset)
 
 

@@ -301,16 +301,24 @@ def _resolve_optimizer(optimizer):
 
 
 def _epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int,
-                   epoch: int, shuffle: bool, seed: int):
+                   epoch: int, shuffle: bool, seed: int,
+                   num_steps: Optional[int] = None):
     """One epoch of fixed-shape batches: the last ragged batch is wrapped
     with leading samples so every device batch has the full shape (no
     recompiles, no masking — standard for small transfer-learning sets).
     Per-epoch seeding keeps shuffling deterministic under checkpoint
-    resume."""
+    resume.
+
+    ``num_steps`` pins the number of batches yielded regardless of the
+    local row count (wrapping modularly) — multi-controller fits use it so
+    every host executes the same number of collective steps even when the
+    per-host shards are unequal."""
     n = x.shape[0]
     rng = np.random.default_rng(seed + epoch)
     order = rng.permutation(n) if shuffle else np.arange(n)
-    for off in range(0, n, batch_size):
+    steps = -(-n // batch_size) if num_steps is None else int(num_steps)
+    for s in range(steps):
+        off = s * batch_size
         idx = order[off:off + batch_size]
         if len(idx) < batch_size:
             # Modular wrap keeps the batch exactly batch_size even when the
@@ -359,11 +367,37 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         logger.info("global batch rounded up to %d (multiple of %d-way "
                     "data axis)", batch_size, dp)
     pc = jax.process_count()
+    steps_per_epoch = None
     if pc > 1:
-        # Multi-controller: (x, y) are THIS host's shard (see
-        # distributed.shard_files); each host iterates local batches of
-        # global_batch/pc rows and put_batch assembles the global array.
-        batch_size = max(dp // pc, batch_size // pc)
+        # Multi-controller GLOBAL-BATCH SPEC: (x, y) are THIS host's shard
+        # (see distributed.shard_files).
+        #   * The user's ``batch_size`` is the GLOBAL batch — rows per
+        #     optimizer step across all hosts — already rounded up to a
+        #     multiple of the data-axis size ``dp`` above.
+        #   * Each host contributes ``local_batch = global/pc`` rows per
+        #     step (every host has dp/pc local devices, so this stays
+        #     device-aligned), floored at one row per local device.
+        #   * Steps per epoch derive from the GLOBAL row count (allgather of
+        #     local counts) so every host executes the SAME number of
+        #     collective steps; hosts with short shards wrap modularly —
+        #     without this, unequal shards (guaranteed when rows % pc != 0)
+        #     run different step counts and the psum deadlocks.
+        from jax.experimental import multihost_utils
+
+        local_batch = max(dp // pc, batch_size // pc)
+        counts = multihost_utils.process_allgather(
+            np.asarray(x.shape[0], np.int64))
+        if int(np.min(counts)) == 0:
+            # A zero-row host cannot contribute its local_batch share to
+            # make_array_from_process_local_data; every host sees the same
+            # counts, so this raises consistently instead of hanging.
+            raise ValueError(
+                f"multi-controller fit requires >=1 row on every host; "
+                f"per-host row counts: {counts.tolist()} (fewer files than "
+                f"processes? see distributed.shard_files)")
+        global_rows = int(np.sum(counts))
+        steps_per_epoch = max(1, -(-global_rows // (local_batch * pc)))
+        batch_size = local_batch
     else:
         batch_size = min(batch_size, max(dp, (x.shape[0] // dp) * dp))
 
@@ -405,7 +439,8 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
     epoch_losses = []
     for epoch in range(start_epoch, epochs):
         losses = []
-        for bx, by in _epoch_batches(x, y, batch_size, epoch, shuffle, seed):
+        for bx, by in _epoch_batches(x, y, batch_size, epoch, shuffle, seed,
+                                     num_steps=steps_per_epoch):
             bx_d, by_d = step.put_batch(bx, by)
             if with_stats:
                 params, stats, opt_state, lval = step(
@@ -416,7 +451,7 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         mean = float(np.mean([float(l) for l in losses]))
         epoch_losses.append(mean)
         metrics.record_time("epoch_loss", mean)
-        if ckptr is not None and ckptr.due(epoch + 1):
+        if ckptr is not None and ckptr.due(epoch + 1) and ckptr.is_writer():
             # Gather to host only on epochs the cadence actually saves —
             # the device->host transfer of the full state is not free.
             # Gathering does not invalidate the device arrays; the next

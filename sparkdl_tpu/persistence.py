@@ -19,6 +19,10 @@ refuses (loudly) to silently drop non-serializable ones a subclass didn't
 handle.  Callables go through pickle — module-level functions round-trip;
 lambdas/closures fail at SAVE time with a clear error, matching Spark's
 behavior of failing writes for non-serializable stage state.
+
+**Trust model:** ``load_stage`` imports the class named in ``metadata.json``
+and unpickles ``payload.pkl`` — loading a directory you did not write is
+arbitrary code execution (see the :func:`load_stage` warning).
 """
 
 from __future__ import annotations
@@ -35,6 +39,49 @@ from sparkdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _FORMAT_VERSION = 1
+
+
+def persistable_train_fn(mf):
+    """``mf.train_fn`` if it survives pickling, else None (with a warning).
+
+    Module-level train_fns round-trip; closure-built ones (e.g. from
+    ``ModelFunction.from_flax``) cannot be pickled — rather than failing a
+    save that used to succeed, the restored stage gets ``train_fn=None``
+    and loses only the ability to re-fit with ``trainBatchStats=True``."""
+    fn = getattr(mf, "train_fn", None)
+    if fn is None:
+        return None
+    try:
+        pickle.dumps(fn)
+    except Exception:
+        logger.warning(
+            "modelFunction.train_fn is not picklable (closure?); the "
+            "restored stage will have train_fn=None and cannot re-fit "
+            "with trainBatchStats=True")
+        return None
+    return fn
+
+
+def modelfunction_payload(mf) -> Dict[str, Any]:
+    """The pickles payload for a ModelFunction (sans variables — those go
+    to orbax).  The single source of truth for the payload schema; the
+    inverse is :func:`modelfunction_from_payload`."""
+    return {
+        "fn": mf.fn,
+        "train_fn": persistable_train_fn(mf),
+        "input_names": list(mf.input_names),
+        "output_names": list(mf.output_names),
+    }
+
+
+def modelfunction_from_payload(payload: Dict[str, Any], variables):
+    """Rebuild a ModelFunction from :func:`modelfunction_payload` output."""
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    return ModelFunction(fn=payload["fn"], variables=variables,
+                         train_fn=payload.get("train_fn"),
+                         input_names=tuple(payload["input_names"]),
+                         output_names=tuple(payload["output_names"]))
 
 
 def _is_jsonable(v) -> bool:
@@ -109,7 +156,15 @@ def save_stage(stage, path: str, overwrite: bool = False) -> str:
 
 
 def load_stage(path: str):
-    """Read a stage previously written by :func:`save_stage`."""
+    """Read a stage previously written by :func:`save_stage`.
+
+    .. warning:: **Trust model — load only directories you wrote.**
+       The metadata names a class to import and ``payload.pkl`` is
+       unpickled: loading a stage directory from an untrusted source is
+       arbitrary code execution, exactly like ``pickle.load`` (and like
+       loading untrusted Keras ``.h5``/TF SavedModels).  There is no
+       sandbox; treat stage directories as code, not data.
+    """
     path = os.path.abspath(path)
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
@@ -137,9 +192,12 @@ def load_stage(path: str):
 
 class PersistableModelFunctionMixin:
     """Persistence for stages holding a ``modelFunction`` param (and an
-    optional ``imageLoader``): variables go to orbax, the fn through pickle
-    (module-level fns only).  Stages with a set ``modelFile`` skip pickling
-    the fn — it is rebuilt from the keras file on load."""
+    optional ``imageLoader``): variables go to orbax, the fn (and train_fn,
+    when present) through pickle (module-level fns only).  Stages with a set
+    ``modelFile`` skip pickling the fns — they are rebuilt from the keras
+    file on load, which currently yields ``train_fn=None`` (keras-converted
+    models have no train-mode apply; only flax-backed ModelFunctions keep
+    ``trainBatchStats`` refit ability through a save/load round-trip)."""
 
     def _persist(self, path: str):
         extra: Dict[str, Any] = {}
@@ -153,11 +211,7 @@ class PersistableModelFunctionMixin:
             if has_model_file:
                 extra["modelFunction"] = "from-modelFile"
             else:
-                pickles["modelFunction"] = {
-                    "fn": mf.fn,
-                    "input_names": list(mf.input_names),
-                    "output_names": list(mf.output_names),
-                }
+                pickles["modelFunction"] = modelfunction_payload(mf)
         if (self.hasParam("imageLoader")
                 and self.isSet(self.getParam("imageLoader"))):
             pickles["imageLoader"] = self.getImageLoader()
@@ -168,12 +222,8 @@ class PersistableModelFunctionMixin:
         stage = cls()
         mfp = pickles.get("modelFunction")
         if mfp is not None:
-            from sparkdl_tpu.graph.function import ModelFunction
-
-            stage._set(modelFunction=ModelFunction(
-                fn=mfp["fn"], variables=pytree["variables"],
-                input_names=tuple(mfp["input_names"]),
-                output_names=tuple(mfp["output_names"])))
+            stage._set(modelFunction=modelfunction_from_payload(
+                mfp, pytree["variables"]))
         if "imageLoader" in pickles:
             stage._set(imageLoader=pickles["imageLoader"])
         return stage

@@ -1,0 +1,62 @@
+"""Worker script for the 2-process jax.distributed integration test.
+
+Run as: python _multihost_worker.py <pid> <nproc> <port> <out.json> [ckpt_dir]
+
+Each process gets an UNEQUAL local shard (10 vs 6 rows — the case that
+used to deadlock when steps-per-epoch derived from the local count) and
+runs a data-parallel fit through the production fit_data_parallel path:
+put_sharded's make_array_from_process_local_data branch, the global
+steps-per-epoch allgather, and (with ckpt_dir) process-0-gated checkpoint
+writes all execute for real.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+    ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else None
+
+    import jax
+
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=nproc, process_id=pid)
+
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.parallel import mesh as mesh_lib
+    from sparkdl_tpu.parallel.train import fit_data_parallel
+
+    # Unequal shards across hosts (rows % nproc != 0 overall).
+    n_local = 10 if pid == 0 else 6
+    rng = np.random.default_rng(100 + pid)
+    w_true = (np.arange(5, dtype=np.float32)[:, None] - 2.0) / 5.0
+    x = rng.normal(size=(n_local, 5)).astype(np.float32)
+    y = x @ w_true
+
+    def predict(p, xb):
+        return jnp.asarray(xb) @ p["w"]
+
+    params = {"w": np.zeros((5, 1), np.float32)}
+    fitted, losses = fit_data_parallel(
+        predict, params, x, y, optimizer=optax.sgd(0.05), loss="mse",
+        batch_size=8, epochs=3, seed=0, mesh=mesh_lib.get_mesh(),
+        checkpoint_dir=ckpt_dir)
+
+    with open(out_path, "w") as f:
+        json.dump({
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "losses": [float(l) for l in losses],
+            "w": np.asarray(fitted["w"]).ravel().tolist(),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

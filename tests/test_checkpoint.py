@@ -67,3 +67,23 @@ def test_fit_resume_matches_uninterrupted(tmp_path, rng):
     resumed, losses_resumed = run(part_dir, 6)
     assert len(losses_resumed) == 3  # only epochs 4..6 ran after resume
     np.testing.assert_allclose(resumed["w"], full["w"], rtol=1e-5, atol=1e-6)
+
+
+def test_maybe_save_gated_to_writer_process(tmp_path, monkeypatch):
+    """Multi-controller: only process 0 writes checkpoints — concurrent
+    orbax tmp-dir renames from several hosts race on shared storage
+    (ADVICE round 2)."""
+    import jax
+
+    from sparkdl_tpu.checkpoint import TrainCheckpointer
+
+    ck = TrainCheckpointer(str(tmp_path / "ck"))
+    state = {"w": np.zeros(2, np.float32)}
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert not ck.is_writer()
+    assert ck.maybe_save(1, state) is None
+    assert ck.latest() is None
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert ck.is_writer()
+    assert ck.maybe_save(1, state) is not None
+    assert ck.latest()[0] == 1

@@ -347,3 +347,42 @@ def test_train_batch_stats_requires_train_fn(uri_label_df):
         loss="mse", trainBatchStats=True)
     with pytest.raises(ValueError, match="trainBatchStats"):
         est.fit(uri_label_df)
+
+
+def test_epoch_batches_pinned_step_count():
+    """Multi-controller fits pin num_steps so unequal per-host shards run
+    the SAME number of collective steps (ADVICE round 2 deadlock fix):
+    short hosts wrap modularly, long hosts truncate."""
+    from sparkdl_tpu.parallel.train import _epoch_batches
+
+    x = np.arange(10, dtype=np.float32)[:, None]
+    y = np.arange(10, dtype=np.float32)
+    # more steps than local data covers -> wraps
+    batches = list(_epoch_batches(x, y, batch_size=4, epoch=0, shuffle=False,
+                                  seed=0, num_steps=5))
+    assert len(batches) == 5
+    assert all(bx.shape == (4, 1) for bx, _ in batches)
+    # fewer steps than local data covers -> truncates
+    batches = list(_epoch_batches(x, y, batch_size=4, epoch=0, shuffle=False,
+                                  seed=0, num_steps=1))
+    assert len(batches) == 1
+
+
+def test_transform_param_override_not_stale(uri_label_df):
+    """Params.copy() shallow-copies __dict__, so the fitted model's cached
+    transformer must be keyed by its params — a transform-time outputCol
+    override or a later setter must not reuse the stale one (ADVICE r2)."""
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=_tiny_trainable_mf(), imageLoader=_loader,
+        loss="categorical_crossentropy", fitParams={"epochs": 1},
+        batchSize=8)
+    model = est.fit(uri_label_df)
+    out1 = model.transform(uri_label_df)
+    assert "preds" in out1.columns
+    out2 = model.transform(uri_label_df,
+                           {model.getParam("outputCol"): "other"})
+    assert "other" in out2.columns
+    model._set(outputCol="third")
+    out3 = model.transform(uri_label_df)
+    assert "third" in out3.columns

@@ -162,3 +162,48 @@ def test_load_type_check(tmp_path):
     ft.save(p)
     with pytest.raises(TypeError, match="not a"):
         LogisticRegressionModel.load(p)
+
+
+def _train_fn_stub(v, x):
+    return _linear_fn(v, x), {}
+
+
+def test_train_fn_roundtrips(tmp_path):
+    """A picklable train_fn survives save/load so the restored model can
+    still re-fit with trainBatchStats=True (ADVICE round 2)."""
+    from sparkdl_tpu.estimators.image_file_estimator import ImageFileModel
+
+    rng = np.random.default_rng(0)
+    mf = ModelFunction(
+        fn=_linear_fn, train_fn=_train_fn_stub,
+        variables={"w": rng.normal(0, 0.01, (8 * 8 * 3, 2)).astype(np.float32)})
+    model = ImageFileModel(modelFunction=mf)
+    model._set(inputCol="uri", outputCol="preds", imageLoader=_loader8,
+               batchSize=8)
+    p = str(tmp_path / "with_train_fn")
+    model.save(p)
+    loaded = ImageFileModel.load(p)
+    lmf = loaded.getModelFunction()
+    assert lmf.train_fn is not None
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    pred, stats = lmf.train_fn(lmf.variables, x)
+    np.testing.assert_allclose(np.asarray(pred),
+                               np.asarray(_linear_fn(mf.variables, x)))
+
+
+def test_closure_train_fn_dropped_not_fatal(tmp_path):
+    """An unpicklable train_fn (e.g. from_flax closures) must not fail a
+    save that used to succeed: it is dropped with a warning and the loaded
+    model has train_fn=None."""
+    from sparkdl_tpu.estimators.image_file_estimator import ImageFileModel
+
+    rng = np.random.default_rng(0)
+    mf = ModelFunction(
+        fn=_linear_fn, train_fn=lambda v, x: (_linear_fn(v, x), {}),
+        variables={"w": rng.normal(0, 0.01, (8 * 8 * 3, 2)).astype(np.float32)})
+    model = ImageFileModel(modelFunction=mf)
+    model._set(inputCol="uri", outputCol="preds", batchSize=8)
+    p = str(tmp_path / "closure_train_fn")
+    model.save(p)
+    loaded = ImageFileModel.load(p)
+    assert loaded.getModelFunction().train_fn is None
