@@ -1,89 +1,329 @@
-"""Benchmark: ImageNet featurization images/sec/chip (BASELINE.json metric).
+"""Benchmark suite: the five BASELINE.md configs, one JSON line each.
 
-Measures the production inference path on the available device(s): the
-jit-compiled InceptionV3 featurize program (uint8 input, fused preprocess,
-fixed padded batch shape) fed through parallel.engine's streaming window —
-the same code DeepImageFeaturizer.transform runs.
+Output contract: every line is a JSON object
+    {"config": ..., "metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+The HEADLINE (config #1, device-resident InceptionV3 featurization
+images/sec/chip — the driver's tracked metric) is printed LAST so a
+parse-the-final-line driver keeps seeing the same series as rounds 1-2.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+Measurement methodology (see PERF.md for the full analysis):
 
-``vs_baseline``: the reference publishes no numbers (BASELINE.md); the
-denominator is the era-typical single-V100 TF-1.x InceptionV3 batch-inference
-rate (~875 images/sec/GPU) implied by the north-star's 8xV100 comparison
-cluster.  The north-star asks for >=4x per-chip; vs_baseline is value/875.
+* Device-resident configs use K model applications inside ONE jit program
+  (``lax.scan`` over a stacked input) with a single scalar fetch.  On this
+  sandbox's relayed TPU, ``jax.block_until_ready`` can return before device
+  work completes and every per-dispatch result fetch pays a relay round
+  trip, so dispatch-loop timing (rounds 1-2) can over- OR under-estimate.
+  The scan method has neither artifact; it slightly UNDERestimates steady
+  state (no step overlap).
+* End-to-end config #1 measures the code users actually run: JPEG bytes ->
+  host decode+resize (native core when it can win) -> streaming engine ->
+  host feature vectors.  On this 1-vCPU host it is host-decode-bound;
+  PERF.md quantifies the per-core decode rate.
 
-Env knobs: SPARKDL_BENCH_BATCH (default 128), SPARKDL_BENCH_STEPS (default
-30), SPARKDL_BENCH_DTYPE (bfloat16|float32, default bfloat16 — TPU-native
-matmul precision; parity-tested fp32 path is unchanged).
+``vs_baseline``: the reference publishes no numbers (BASELINE.md); where a
+defensible denominator exists (ImageNet-CNN image throughput) it is the
+era-typical single-V100 TF-1.x InceptionV3 batch-inference rate (~875
+images/sec/GPU) implied by the north-star's 8xV100 comparison cluster.
+Non-image-throughput lines report vs_baseline null.
+
+Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default "1e2e,2,3,4,5,1"),
+SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
+(bfloat16|float32).
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import time
 
 import numpy as np
 
-# Era-typical per-V100 TF1 InceptionV3 inference throughput (see module
-# docstring) — the only defensible scalar the reference's north-star gives.
 V100_BASELINE_IPS = 875.0
 
+BATCH = int(os.environ.get("SPARKDL_BENCH_BATCH", "128"))
+STEPS = int(os.environ.get("SPARKDL_BENCH_STEPS", "20"))
+DTYPE = os.environ.get("SPARKDL_BENCH_DTYPE", "bfloat16")
 
-def main():
-    import jax
+
+def emit(config, metric, value, unit, vs_baseline=None):
+    print(json.dumps({
+        "config": config, "metric": metric, "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": (round(float(vs_baseline), 3)
+                        if vs_baseline is not None else None),
+    }), flush=True)
+
+
+def _compute_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if DTYPE == "bfloat16" else jnp.float32
+
+
+def _zoo_fn(name, featurize):
+    """(fn, variables, (h, w)) for a zoo model with fused preprocess."""
+    import jax.numpy as jnp
 
     from sparkdl_tpu.models import get_model_spec
+
+    spec = get_model_spec(name)
+    module = spec.build()
+    variables = spec.init_variables()
+    pre = spec.preprocess
+    cdt = _compute_dtype()
+
+    def fn(v, x):
+        xf = pre(x).astype(cdt)
+        out = module.apply(v, xf, train=False, features=featurize)
+        return out.astype(jnp.float32)
+
+    return fn, variables, spec.input_size
+
+
+def measure_scan(fn, variables, h, w, batch, steps):
+    """images/sec/chip via steps-in-one-program (relay-artifact-free)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from sparkdl_tpu.parallel.engine import InferenceEngine
 
-    batch = int(os.environ.get("SPARKDL_BENCH_BATCH", "128"))
-    steps = int(os.environ.get("SPARKDL_BENCH_STEPS", "30"))
-    dtype_name = os.environ.get("SPARKDL_BENCH_DTYPE", "bfloat16")
+    eng = InferenceEngine(fn, variables, device_batch_size=batch,
+                          compute_dtype=_compute_dtype())
+    rng = np.random.default_rng(0)
+    big = (rng.random((steps, eng.device_batch_size, h, w, 3)) * 255
+           ).astype(np.uint8)
+    sh = NamedSharding(eng.mesh, P(None, "data"))
+    xd = jax.device_put(big, sh)
+
+    def scan_fn(v, xs):
+        def body(c, x):
+            return c + jnp.mean(fn(v, x)), None
+
+        return jax.lax.scan(body, jnp.float32(0), xs)[0]
+
+    g = jax.jit(scan_fn, in_shardings=(eng._replicated, sh))
+    float(g(eng.variables, xd))  # warm: compile + one run
+    t0 = time.perf_counter()
+    float(g(eng.variables, xd))  # one dispatch, one scalar fetch
+    elapsed = time.perf_counter() - t0
+    return steps * eng.device_batch_size / elapsed / eng.num_devices
+
+
+def _jpeg_corpus(n, height=375, width=500):
+    """n distinct in-memory JPEGs (flowers-like sizes)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(7)
+    blobs = []
+    base = (rng.random((height, width, 3)) * 255).astype(np.uint8)
+    for i in range(n):
+        arr = base.copy()
+        arr[:8, :8, 0] = i % 251
+        buf = io.BytesIO()
+        Image.fromarray(arr, "RGB").save(buf, format="JPEG", quality=90)
+        blobs.append(buf.getvalue())
+    return blobs
+
+
+def bench_config1_device():
+    fn, variables, (h, w) = _zoo_fn("InceptionV3", featurize=True)
+    ips = measure_scan(fn, variables, h, w, BATCH, STEPS)
+    emit("1", "InceptionV3 ImageNet featurization throughput", ips,
+         "images/sec/chip", ips / V100_BASELINE_IPS)
+
+
+def bench_config1_e2e():
+    """The user path: JPEG bytes -> decode+resize -> streaming featurize."""
+    from sparkdl_tpu.image.io import decodeResizeBatch
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+    from sparkdl_tpu.utils.prefetch import prefetch_iter
+
+    fn, variables, (h, w) = _zoo_fn("InceptionV3", featurize=True)
+    eng = InferenceEngine(fn, variables, device_batch_size=BATCH,
+                          compute_dtype=_compute_dtype())
+    n = int(os.environ.get("SPARKDL_BENCH_E2E_IMAGES", "384"))
+    blobs = _jpeg_corpus(n)
+
+    def chunks():
+        for off in range(0, n, eng.device_batch_size):
+            batch, _ok = decodeResizeBatch(
+                blobs[off:off + eng.device_batch_size], h, w)
+            yield batch
+
+    # warm the compile so e2e measures steady state, not compilation
+    w0, _ = decodeResizeBatch(blobs[:eng.device_batch_size], h, w)
+    list(eng.map_batches([w0]))
+    t0 = time.perf_counter()
+    outs = list(eng.map_batches(prefetch_iter(chunks(), depth=2)))
+    elapsed = time.perf_counter() - t0
+    rows = sum(o.shape[0] for o in outs)
+    assert rows == n
+    ips = rows / elapsed / eng.num_devices
+    emit("1-e2e", "InceptionV3 featurization from JPEG bytes (host decode)",
+         ips, "images/sec/chip", ips / V100_BASELINE_IPS)
+
+
+def bench_config2():
+    for name in ("ResNet50", "Xception", "VGG16"):
+        fn, variables, (h, w) = _zoo_fn(name, featurize=False)
+        steps = max(6, STEPS // 2)
+        ips = measure_scan(fn, variables, h, w, BATCH, steps)
+        emit("2", f"DeepImagePredictor {name} batch inference", ips,
+             "images/sec/chip", ips / V100_BASELINE_IPS)
+
+
+def bench_config3():
+    """KerasTransformer on a user Keras model (MLP over vector rows)."""
+    import keras
+    from keras import layers
+
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.transformers.tensor import KerasTransformer
+
+    dim, n = 784, 16384
+    model = keras.Sequential([
+        layers.Input((dim,)),
+        layers.Dense(512, activation="relu"),
+        layers.Dense(256, activation="relu"),
+        layers.Dense(10, activation="softmax"),
+    ])
+    path = "/tmp/sparkdl_bench_mlp.keras"
+    model.save(path)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    df = DataFrame({"features": [row for row in x]})
+    t = KerasTransformer(inputCol="features", outputCol="preds",
+                         modelFile=path, batchSize=8192)
+    t.transform(df)  # warm: conversion + compile
+    t0 = time.perf_counter()
+    out = t.transform(df)
+    elapsed = time.perf_counter() - t0
+    assert len(out) == n
+    emit("3", "KerasTransformer user-MLP rows/sec", n / elapsed, "rows/sec")
+
+
+def bench_config4():
+    """Registered image UDF scoring an image-struct column."""
+    import pyarrow as pa
+
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
+    from sparkdl_tpu.models import get_model_spec
+    from sparkdl_tpu.udf.registry import register_image_udf, udf_registry
 
     spec = get_model_spec("InceptionV3")
     module = spec.build()
     variables = spec.init_variables()
     pre = spec.preprocess
+    cdt = _compute_dtype()
+
+    def fn(v, x):  # x float32 [0,255] from the UDF converter stage
+        xf = pre(x.astype(jnp.uint8)).astype(cdt)
+        return module.apply(v, xf, train=False, features=False
+                            ).astype(jnp.float32)
+
+    mf = ModelFunction(fn=fn, variables=variables)
+    h, w = spec.input_size
+    register_image_udf("bench_inception_udf", mf, input_size=(h, w),
+                       batch_size=BATCH)
+    n = int(os.environ.get("SPARKDL_BENCH_UDF_IMAGES", "128"))
+    rng = np.random.default_rng(5)
+    structs = [imageArrayToStruct(
+        (rng.random((h, w, 3)) * 255).astype(np.uint8), origin=f"r{i}")
+        for i in range(n)]
+    df = DataFrame({"image": pa.array(structs, type=imageSchema)})
+    udf_registry.apply("bench_inception_udf", df, "image", "probs")  # warm
+    t0 = time.perf_counter()
+    out = udf_registry.apply("bench_inception_udf", df, "image", "probs")
+    elapsed = time.perf_counter() - t0
+    assert len(out) == n
+    emit("4", "registerKerasImageUDF-style image UDF scoring", n / elapsed,
+         "images/sec", (n / elapsed) / V100_BASELINE_IPS)
+
+
+def bench_config5():
+    """Estimator hyperparameter fan-out: fitMultiple over a param grid."""
+    import tempfile
 
     import jax.numpy as jnp
+    from PIL import Image
 
-    compute_dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    from sparkdl_tpu.estimators import ImageFileEstimator
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    rng = np.random.default_rng(11)
+    d = tempfile.mkdtemp(prefix="sparkdl_bench_est_")
+    n, hw = 256, 32
+    paths = []
+    for i in range(n):
+        p = os.path.join(d, f"img_{i:04d}.jpg")
+        Image.fromarray(
+            (rng.random((hw, hw, 3)) * 255).astype(np.uint8), "RGB"
+        ).save(p, format="JPEG")
+        paths.append(p)
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0] for i in range(n)]
+    df = DataFrame({"uri": paths, "label": labels})
+
+    def loader(uri):
+        img = Image.open(uri).convert("RGB")
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    w0 = rng.normal(0, 0.01, (hw * hw * 3, 2)).astype(np.float32)
 
     def fn(v, x):
-        xf = pre(x).astype(compute_dtype)
-        feats = module.apply(v, xf, train=False, features=True)
-        return feats.astype(jnp.float32)
+        logits = jnp.asarray(x).reshape(x.shape[0], -1) @ v["w"]
+        return jnp.exp(logits) / jnp.sum(jnp.exp(logits), axis=-1,
+                                         keepdims=True)
 
-    eng = InferenceEngine(fn, variables, device_batch_size=batch,
-                          compute_dtype=compute_dtype)
-    h, w = spec.input_size
-    rng = np.random.default_rng(0)
-    data = (rng.random((eng.device_batch_size, h, w, 3)) * 255).astype(np.uint8)
-
-    # Device-resident input: this measures the featurization program itself.
-    # (In this sandbox host->device goes through a ~57MB/s relay tunnel — an
-    # environment artifact; real host DMA moves a 34MB uint8 batch in ~3ms,
-    # fully overlapped by the engine's async dispatch window.)
-    x = jax.device_put(data, eng._batch_sharding)
-
-    # warmup: compile + first run
-    jax.block_until_ready(eng._compiled(eng.variables, x))
-
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=ModelFunction(fn=fn, variables={"w": w0}),
+        imageLoader=loader, optimizer="sgd",
+        loss="categorical_crossentropy", fitParams={"epochs": 2},
+        batchSize=64)
+    maps = [{est.fitParams: {"epochs": 2}},
+            {est.fitParams: {"epochs": 2}, est.batchSize: 128}]
+    est.fit(df, [maps[0]])  # warm: decode + compile
     t0 = time.perf_counter()
-    outs = [eng._compiled(eng.variables, x) for _ in range(steps)]
-    jax.block_until_ready(outs)
+    models = est.fit(df, maps)
     elapsed = time.perf_counter() - t0
+    assert len(models) == len(maps)
+    epochs_total = 2 * len(maps)
+    emit("5", "ImageFileEstimator param-grid tuning throughput",
+         n * epochs_total / elapsed, "train-images/sec")
 
-    total = steps * eng.device_batch_size
-    ips = total / elapsed
-    ips_chip = ips / eng.num_devices
-    print(json.dumps({
-        "metric": "InceptionV3 ImageNet featurization throughput",
-        "value": round(ips_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips_chip / V100_BASELINE_IPS, 3),
-    }))
+
+BENCHES = {
+    "1": bench_config1_device,
+    "1e2e": bench_config1_e2e,
+    "2": bench_config2,
+    "3": bench_config3,
+    "4": bench_config4,
+    "5": bench_config5,
+}
+
+
+def main():
+    # headline ("1") last so the driver's final-line parse tracks it
+    default = "1e2e,2,3,4,5,1"
+    wanted = os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")
+    for key in wanted:
+        key = key.strip()
+        fn = BENCHES.get(key)
+        if fn is None:
+            continue
+        try:
+            fn()
+        except Exception as e:  # one failing config must not kill the rest
+            print(json.dumps({"config": key, "error": repr(e)[:300]}),
+                  flush=True)
 
 
 if __name__ == "__main__":

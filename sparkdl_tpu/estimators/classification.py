@@ -58,6 +58,14 @@ class LogisticRegression(Estimator, _HasClassifierCols):
                       typeConverter=TypeConverters.toInt)
     seed = Param("undefined", "seed", "shuffle/init seed",
                  typeConverter=TypeConverters.toInt)
+    standardization = Param(
+        "undefined", "standardization",
+        "standardize features (zero mean / unit variance) before fitting, "
+        "folding the scaler back into the returned linear weights — the "
+        "pyspark.ml.LogisticRegression default, and what makes tiny- or "
+        "wildly-scaled feature columns (e.g. deep-CNN featurizer outputs) "
+        "trainable at a fixed learning rate",
+        typeConverter=TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, featuresCol: str = "features", labelCol: str = "label",
@@ -65,13 +73,13 @@ class LogisticRegression(Estimator, _HasClassifierCols):
                  probabilityCol: str = "probability",
                  maxIter: int = 50, regParam: float = 0.0,
                  learningRate: float = 0.05, batchSize: int = 256,
-                 seed: int = 0):
+                 seed: int = 0, standardization: bool = True):
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
                          probabilityCol="probability", maxIter=50,
                          regParam=0.0, learningRate=0.05, batchSize=256,
-                         seed=0)
+                         seed=0, standardization=True)
         self._set(**self._input_kwargs)
 
     @keyword_only
@@ -83,7 +91,8 @@ class LogisticRegression(Estimator, _HasClassifierCols):
                   regParam: Optional[float] = None,
                   learningRate: Optional[float] = None,
                   batchSize: Optional[int] = None,
-                  seed: Optional[int] = None):
+                  seed: Optional[int] = None,
+                  standardization: Optional[bool] = None):
         return self._set(**self._input_kwargs)
 
     def _fit(self, dataset) -> "LogisticRegressionModel":
@@ -97,6 +106,15 @@ class LogisticRegression(Estimator, _HasClassifierCols):
             raise ValueError(f"featuresCol must hold vectors; got shape "
                              f"{x.shape}")
         num_classes = int(y.max()) + 1
+        mu = np.zeros((x.shape[1],), np.float32)
+        sigma = np.ones((x.shape[1],), np.float32)
+        if self.getOrDefault(self.standardization):
+            mu = x.mean(axis=0)
+            sd = x.std(axis=0)
+            # constant features train a zero coefficient either way; leave
+            # them unscaled so the fold-back below cannot blow up on ~0 std
+            sigma = np.where(sd < 1e-7, 1.0, sd).astype(np.float32)
+            x = (x - mu) / sigma
         rng = np.random.default_rng(self.getOrDefault(self.seed))
         params = {
             "w": (rng.normal(0, 0.01, (x.shape[1], num_classes))
@@ -126,6 +144,15 @@ class LogisticRegression(Estimator, _HasClassifierCols):
             seed=self.getOrDefault(self.seed))
         logger.info("LogisticRegression fit: %d classes, final loss %.4f",
                     num_classes, losses[-1] if losses else float("nan"))
+        if self.getOrDefault(self.standardization):
+            # Fold the scaler into the head so the fitted model stays a
+            # pure linear (w, b): ((x-mu)/sigma) @ w + b = x @ w' + b'.
+            w = np.asarray(fitted["w"])
+            fitted = {
+                "w": (w / sigma[:, None]).astype(np.float32),
+                "b": (np.asarray(fitted["b"])
+                      - (mu / sigma) @ w).astype(np.float32),
+            }
         model = LogisticRegressionModel(weights=fitted,
                                         numClasses=num_classes)
         model._set(featuresCol=self.getFeaturesCol(),

@@ -143,31 +143,66 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         return True
 
     # -- data loading (reference: _getNumpyFeaturesAndLabels) --------------
-    def _load_numpy(self, dataset) -> Tuple[np.ndarray, np.ndarray]:
-        uris = dataset.table.column(self.getInputCol()).to_pylist()
-        labels = dataset.table.column(self.getLabelCol()).to_pylist()
-        loader = self.getImageLoader()
-        with ThreadPoolExecutor(min(16, max(2, len(uris)))) as ex:
-            arrays = list(ex.map(lambda u: np.asarray(loader(u)), uris))
-        x = np.stack(arrays).astype(np.float32)
+    @staticmethod
+    def _stack_labels(labels) -> np.ndarray:
         y = np.asarray(labels)
         if y.dtype == object:  # one-hot rows as lists
             y = np.asarray([np.asarray(v, dtype=np.float32) for v in labels])
-        return x, y
+        return y
+
+    def _decode_uris(self, uris, loader) -> list:
+        """Threaded decode of a URI list to arrays (shared by the cached
+        whole-dataset path and the streaming per-chunk path)."""
+        with ThreadPoolExecutor(min(16, max(2, len(uris)))) as ex:
+            return list(ex.map(lambda u: np.asarray(loader(u)), uris))
+
+    def _load_numpy(self, dataset) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode the URI column to a stacked float32 batch + labels.
+
+        Decoded images are cached per URI on the estimator, so a
+        CrossValidator's k folds x m maps + final refit pay ONE decode pass
+        over the dataset instead of k+1 (the TPU-side analog of the
+        reference broadcasting the decoded arrays once).  The cache is
+        keyed by the imageLoader and shared by ``copy()``d estimators
+        (Params.copy shallow-copies __dict__) — exactly the fold/map
+        copies that would otherwise re-decode."""
+        uris = dataset.table.column(self.getInputCol()).to_pylist()
+        labels = dataset.table.column(self.getLabelCol()).to_pylist()
+        loader = self.getImageLoader()
+        cache = self.__dict__.get("_decode_cache")
+        if cache is None or cache[0] is not loader:
+            cache = (loader, {})
+            self.__dict__["_decode_cache"] = cache
+        decoded = cache[1]
+        missing = [u for u in dict.fromkeys(uris) if u not in decoded]
+        if missing:
+            for u, arr in zip(missing, self._decode_uris(missing, loader)):
+                decoded[u] = arr
+        x = np.stack([decoded[u] for u in uris]).astype(np.float32)
+        return x, self._stack_labels(labels)
+
+    def clearDecodeCache(self) -> None:
+        """Drop cached decoded images (they hold the decoded dataset in
+        host RAM until the estimator is garbage-collected)."""
+        self.__dict__.pop("_decode_cache", None)
 
     # -- fitting -----------------------------------------------------------
-    def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray) -> "ImageFileModel":
-        mf = self.getModelFunction()
+    def _common_fit_kwargs(self) -> Dict:
         fp = self.getFitParams()
-        common = dict(
+        return dict(
             optimizer=self.getOptimizer(),
             loss=self.getLoss(),
             batch_size=self.getBatchSize(),
             epochs=int(fp.get("epochs", 1)),
-            shuffle=bool(fp.get("shuffle", True)),
-            seed=int(fp.get("seed", 0)),
             checkpoint_dir=fp.get("checkpoint_dir"),
             checkpoint_every_epochs=int(fp.get("checkpoint_every_epochs", 1)))
+
+    def _fit_with_runner(self, runner, common: Dict) -> "ImageFileModel":
+        """Shared fit logic: ``runner(fn, params, **kw) -> (fitted, losses)``
+        binds the data (in-memory arrays or a streaming source); this method
+        owns the BatchNorm-stats branching, the frozen-stats predict closure
+        cache, and fitted-model assembly."""
+        mf = self.getModelFunction()
         has_stats = (isinstance(mf.variables, dict)
                      and "batch_stats" in mf.variables)
         if self.getTrainBatchStats():
@@ -176,8 +211,8 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                     "trainBatchStats=True requires a model with a "
                     "train-mode apply and batch_stats collections "
                     "(e.g. ModelFunction.from_flax on a BatchNorm module)")
-            fitted, losses = fit_data_parallel(
-                mf.fn, mf.variables["params"], x, y,
+            fitted, losses = runner(
+                mf.fn, mf.variables["params"],
                 train_fn=mf.train_fn,
                 stats=mf.variables["batch_stats"], **common)
             new_vars = dict(mf.variables)
@@ -197,14 +232,13 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                 # cache on the ModelFunction so repeated fits (param maps,
                 # folds) reuse one closure -> one compiled step
                 mf._frozen_stats_predict = predict
-            fitted, losses = fit_data_parallel(
-                predict, mf.variables["params"], x, y, **common)
+            fitted, losses = runner(
+                predict, mf.variables["params"], **common)
             new_vars = {k: v for k, v in mf.variables.items()
                         if k != "params"}
             new_vars["params"] = fitted
         else:
-            fitted, losses = fit_data_parallel(
-                mf.fn, mf.variables, x, y, **common)
+            fitted, losses = runner(mf.fn, mf.variables, **common)
             new_vars = fitted
         from sparkdl_tpu.graph.function import ModelFunction
 
@@ -225,10 +259,58 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
             model.modelFile = self.getOrDefault(self.getParam("modelFile"))
         return model
 
+    def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray) -> "ImageFileModel":
+        fp = self.getFitParams()
+        common = self._common_fit_kwargs()
+        common.update(shuffle=bool(fp.get("shuffle", True)),
+                      seed=int(fp.get("seed", 0)))
+
+        def runner(fn, params, **kw):
+            return fit_data_parallel(fn, params, x, y, **kw)
+
+        return self._fit_with_runner(runner, common)
+
     def _fit(self, dataset) -> "ImageFileModel":
         self._validateParams()
+        if callable(dataset) and not hasattr(dataset, "table"):
+            return self._fit_stream(dataset)
         x, y = self._load_numpy(dataset)
         return self._fit_on_arrays(x, y)
+
+    # -- streaming fit (larger-than-RAM datasets) ---------------------------
+    def _decode_record_batch(self, rb) -> Tuple[np.ndarray, np.ndarray]:
+        """One {inputCol, labelCol} RecordBatch -> (x_chunk, y_chunk).
+        No per-URI caching here — by definition the dataset may not fit."""
+        uris = rb.column(rb.schema.get_field_index(
+            self.getInputCol())).to_pylist()
+        labels = rb.column(rb.schema.get_field_index(
+            self.getLabelCol())).to_pylist()
+        arrays = self._decode_uris(uris, self.getImageLoader())
+        return np.stack(arrays).astype(np.float32), self._stack_labels(labels)
+
+    def _fit_stream(self, source) -> "ImageFileModel":
+        """Fit from a RE-ITERABLE epoch source for datasets larger than
+        host RAM: ``source() -> iterator of pyarrow RecordBatches`` holding
+        the URI + label columns (e.g. ``imageIO.iterFileBatches``-style
+        readers, per-host sharded via ``distributed.shard_files``).  Each
+        epoch re-iterates the source; peak host memory is O(record batch),
+        never the dataset (SURVEY.md §7 step 1).  ``fitParams`` may carry
+        ``steps_per_epoch`` (REQUIRED multi-controller)."""
+        from sparkdl_tpu.parallel.train import fit_data_parallel_stream
+
+        fp = self.getFitParams()
+        common = self._common_fit_kwargs()
+        common.update(steps_per_epoch=(int(fp["steps_per_epoch"])
+                                       if "steps_per_epoch" in fp else None))
+
+        def chunks():
+            for rb in source():
+                yield self._decode_record_batch(rb)
+
+        def runner(fn, params, **kw):
+            return fit_data_parallel_stream(fn, params, chunks, **kw)
+
+        return self._fit_with_runner(runner, common)
 
     def fitMultiple(self, dataset, paramMaps):
         """One model per param map.  Data is loaded ONCE (the analog of the

@@ -198,7 +198,39 @@ class DataFrame:
         else:
             yield from self._table.to_batches(max_chunksize=batch_size)
 
-    def map_rows(self, fn: Callable[[Row], dict]) -> "DataFrame":
+    def map_rows(self, fn: Callable[[Row], dict],
+                 batch_size: int = 1024) -> "DataFrame":
         """Row-wise map producing a new frame (host-side; used for cheap
-        struct manipulation like resize UDFs, never for model compute)."""
-        return DataFrame.from_rows([fn(r) for r in self.collect()])
+        struct manipulation like resize UDFs, never for model compute).
+
+        Processed BATCH-WISE: rows of one record batch are materialized,
+        mapped, and converted back to arrow before the next batch is
+        touched — peak Python-object residency is O(batch_size), not the
+        table.  The schema is inferred from the first NON-EMPTY mapped
+        batch and promoted (null -> concrete, int -> float, ...) when a
+        later batch widens it — matching the old whole-table inference."""
+        out_tables: List[pa.Table] = []
+        schema: Optional[pa.Schema] = None
+        for rb in self.iter_batches(batch_size):
+            mapped = [fn(Row(r)) for r in rb.to_pylist()]
+            if not mapped:
+                continue
+            if schema is None:
+                t = pa.Table.from_pylist(mapped)
+                schema = t.schema
+            else:
+                try:
+                    t = pa.Table.from_pylist(mapped, schema=schema)
+                except pa.ArrowInvalid:
+                    # a later batch widened a column (e.g. null-typed from
+                    # the first batch, concrete now): promote and re-cast
+                    t = pa.Table.from_pylist(mapped)
+                    schema = pa.unify_schemas([schema, t.schema],
+                                              promote_options="permissive")
+                    out_tables = [prev.cast(schema) for prev in out_tables]
+                    t = t.cast(schema)
+            out_tables.append(t)
+        if schema is None:
+            return DataFrame.from_rows([])
+        return DataFrame(pa.concat_tables(
+            [t.cast(schema) for t in out_tables]))

