@@ -78,6 +78,15 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         "(ModelFunction.train_fn, e.g. from_flax with batch_stats).",
         typeConverter=TypeConverters.toBoolean)
 
+    parallelism = Param(
+        "undefined", "parallelism",
+        "max param maps fitted CONCURRENTLY by fitMultiple, each on its own "
+        "slice of the device mesh (the TPU analog of the reference's "
+        "one-Spark-task-per-paramMap fan-out, SURVEY.md §2; same contract "
+        "as pyspark.ml.tuning's parallelism). 1 (default) = sequential "
+        "fits, each spanning the whole mesh.",
+        typeConverter=TypeConverters.toInt)
+
     @keyword_only
     def __init__(self, inputCol: Optional[str] = None,
                  outputCol: Optional[str] = None,
@@ -88,11 +97,12 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                  loss: Optional[Any] = None,
                  fitParams: Optional[Dict] = None,
                  batchSize: Optional[int] = None,
-                 trainBatchStats: Optional[bool] = None):
+                 trainBatchStats: Optional[bool] = None,
+                 parallelism: Optional[int] = None):
         super().__init__()
         self._setDefault(batchSize=32, fitParams={},
                          loss="categorical_crossentropy",
-                         trainBatchStats=False)
+                         trainBatchStats=False, parallelism=1)
         self._set(**self._input_kwargs)
 
     @keyword_only
@@ -105,7 +115,8 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
                   loss: Optional[Any] = None,
                   fitParams: Optional[Dict] = None,
                   batchSize: Optional[int] = None,
-                  trainBatchStats: Optional[bool] = None):
+                  trainBatchStats: Optional[bool] = None,
+                  parallelism: Optional[int] = None):
         return self._set(**self._input_kwargs)
 
     def getTrainBatchStats(self) -> bool:
@@ -259,11 +270,14 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
             model.modelFile = self.getOrDefault(self.getParam("modelFile"))
         return model
 
-    def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray) -> "ImageFileModel":
+    def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray,
+                       mesh=None) -> "ImageFileModel":
         fp = self.getFitParams()
         common = self._common_fit_kwargs()
         common.update(shuffle=bool(fp.get("shuffle", True)),
                       seed=int(fp.get("seed", 0)))
+        if mesh is not None:
+            common["mesh"] = mesh
 
         def runner(fn, params, **kw):
             return fit_data_parallel(fn, params, x, y, **kw)
@@ -314,12 +328,81 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
 
     def fitMultiple(self, dataset, paramMaps):
         """One model per param map.  Data is loaded ONCE (the analog of the
-        reference's single broadcast) and reused across maps."""
+        reference's single broadcast) and reused across maps.
+
+        With ``parallelism > 1`` the device mesh is carved into that many
+        equal slices and maps fit CONCURRENTLY, one thread per slice —
+        the reference fanned maps out as independent Spark tasks; here
+        each fan-out lane is an independent sub-mesh running its own
+        data-parallel fit (SURVEY.md §2 task-parallelism disposition).
+        Model order matches ``paramMaps`` either way.  Single-controller
+        only: a multi-process run falls back to sequential (threads would
+        issue cross-host collectives in unordered interleavings)."""
+        import os
+
         self._validateParams()
         x, y = self._load_numpy(dataset)
-        for i, pm in enumerate(paramMaps):
-            est = self.copy(pm)
-            yield i, est._fit_on_arrays(x, y)
+        maps = list(paramMaps)
+
+        def map_estimator(i):
+            """Per-map estimator copy with a DISAMBIGUATED checkpoint dir:
+            maps sharing one fitParams checkpoint_dir would resume from
+            each other's checkpoints (and, parallel, corrupt them)."""
+            est = self.copy(maps[i])
+            fp = est.getFitParams()
+            if len(maps) > 1 and fp.get("checkpoint_dir"):
+                fp["checkpoint_dir"] = os.path.join(
+                    str(fp["checkpoint_dir"]), f"map_{i:03d}")
+                est._set(fitParams=fp)
+            return est
+
+        import jax
+
+        want = max(1, int(self.getOrDefault(self.parallelism)))
+        if jax.process_count() > 1 and want > 1:
+            logger.warning("fitMultiple parallelism=%d ignored in a "
+                           "multi-controller run (cross-host collectives "
+                           "cannot be interleaved across threads); fitting "
+                           "sequentially", want)
+            want = 1
+        if want <= 1 or len(maps) <= 1:
+            for i in range(len(maps)):
+                yield i, map_estimator(i)._fit_on_arrays(x, y)
+            return
+        from sparkdl_tpu.parallel import mesh as mesh_lib
+
+        devs = jax.devices()
+        k = min(want, len(maps), len(devs))
+        while len(devs) % k:  # equal slices only
+            k -= 1
+        if k <= 1:
+            for i in range(len(maps)):
+                yield i, map_estimator(i)._fit_on_arrays(x, y)
+            return
+        per = len(devs) // k
+        logger.info("fitMultiple fan-out: %d maps over %d mesh slices of "
+                    "%d device(s)", len(maps), k, per)
+        import queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Meshes are leased from a queue, not indexed by map position:
+        # with more maps than slices a freed thread must take a FREE
+        # slice, never double-book one still running another fit.
+        free_meshes: "queue.Queue" = queue.Queue()
+        for g in range(k):
+            free_meshes.put(
+                mesh_lib.get_mesh(devices=devs[g * per:(g + 1) * per]))
+
+        def work(i):
+            mesh = free_meshes.get()
+            try:
+                return map_estimator(i)._fit_on_arrays(x, y, mesh=mesh)
+            finally:
+                free_meshes.put(mesh)
+
+        with ThreadPoolExecutor(k) as ex:
+            for i, model in enumerate(ex.map(work, range(len(maps)))):
+                yield i, model
 
 
 class ImageFileModel(Model, HasInputCol, HasOutputCol, HasBatchSize,

@@ -129,13 +129,26 @@ class TrainStep:
 # same constituents and get back the same jit object, whose own executable
 # cache then hits on equal batch shapes.  Keys use object ids — safe because
 # the cached TrainStep's closure keeps every keyed object alive, so ids
-# cannot be recycled while the entry exists.
+# cannot be recycled while the entry exists.  Insert/evict is locked:
+# fitMultiple's parallel fan-out reaches this from worker threads.
 _STEP_CACHE: Dict[tuple, "TrainStep"] = {}
 _STEP_CACHE_CAP = 16
 
+import threading as _threading
+
+_STEP_CACHE_LOCK = _threading.Lock()
+
+
+def _step_cache_put(key, value) -> None:
+    with _STEP_CACHE_LOCK:
+        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)), None)
+        _STEP_CACHE[key] = value
+
 
 def clear_train_step_cache() -> None:
-    _STEP_CACHE.clear()
+    with _STEP_CACHE_LOCK:
+        _STEP_CACHE.clear()
     _OPT_INSTANCES.clear()
 
 
@@ -187,9 +200,7 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
     result = TrainStep(step_fn=step_fn, mesh=mesh, replicated=replicated,
                        batch_sharded=batch_sharded)
     if cache:
-        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
-            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
-        _STEP_CACHE[key] = result
+        _step_cache_put(key, result)
     return result
 
 
@@ -269,9 +280,7 @@ def make_train_step_with_stats(train_fn: Callable, loss, optimizer,
                                 replicated=replicated,
                                 batch_sharded=batch_sharded)
     if cache:
-        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
-            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
-        _STEP_CACHE[key] = result
+        _step_cache_put(key, result)
     return result
 
 
