@@ -37,25 +37,15 @@ logger = get_logger(__name__)
 # fitted models over one fn with different weights — without this, every
 # model.transform() recompiled the identical program.  Keys use id(fn);
 # safe because the cached jit closes over fn, keeping the id pinned.
-# Insert/evict is locked: fitMultiple's parallel fan-out transforms from
-# worker threads.
-import threading as _threading
+# BoundedCache locks put/evict: fitMultiple's parallel fan-out transforms
+# from worker threads.
+from sparkdl_tpu.utils.cache import BoundedCache
 
-_JIT_CACHE: Dict[tuple, Any] = {}
-_JIT_CACHE_CAP = 32
-_JIT_CACHE_LOCK = _threading.Lock()
-
-
-def _jit_cache_put(key, value) -> None:
-    with _JIT_CACHE_LOCK:
-        while len(_JIT_CACHE) >= _JIT_CACHE_CAP:
-            _JIT_CACHE.pop(next(iter(_JIT_CACHE)), None)
-        _JIT_CACHE[key] = value
+_JIT_CACHE = BoundedCache(cap=32)
 
 
 def clear_engine_jit_cache() -> None:
-    with _JIT_CACHE_LOCK:
-        _JIT_CACHE.clear()
+    _JIT_CACHE.clear()
 
 
 def _cast_floating(variables, dtype):
@@ -117,7 +107,7 @@ class InferenceEngine:
                 in_shardings=(self._replicated, self._batch_sharding),
                 out_shardings=self._batch_sharding,
                 donate_argnums=(1,) if donate_batch else ())
-            _jit_cache_put(key, compiled)
+            _JIT_CACHE.put(key, compiled)
         self._compiled = compiled
 
     # -- low level ---------------------------------------------------------

@@ -96,17 +96,26 @@ def resolve_loss(loss) -> Callable:
 @dataclass
 class TrainStep:
     """A compiled data-parallel step: (params, opt_state, x, y) ->
-    (params, opt_state, loss).  Params/opt_state stay replicated on device
-    across steps; x/y are sharded on the data axis."""
+    (params, opt_state, loss).  Params/opt_state stay device-resident
+    across steps (replicated, or tensor-parallel-sharded on the mesh's
+    ``model`` axis when built with ``param_specs``); x/y are sharded on
+    the data axis."""
 
     step_fn: Callable
     mesh: Any
     replicated: Any
     batch_sharded: Any
+    param_shardings: Any = None  # pytree of NamedSharding when TP is on
 
     def put_state(self, params, opt_state):
         import jax
 
+        if self.param_shardings is not None:
+            params = jax.tree_util.tree_map(
+                jax.device_put, params, self.param_shardings)
+            # opt_state starts replicated; mu/nu layouts converge to the
+            # param shardings after the first step's output propagation.
+            return params, jax.device_put(opt_state, self.replicated)
         return (jax.device_put(params, self.replicated),
                 jax.device_put(opt_state, self.replicated))
 
@@ -129,26 +138,15 @@ class TrainStep:
 # same constituents and get back the same jit object, whose own executable
 # cache then hits on equal batch shapes.  Keys use object ids — safe because
 # the cached TrainStep's closure keeps every keyed object alive, so ids
-# cannot be recycled while the entry exists.  Insert/evict is locked:
+# cannot be recycled while the entry exists.  BoundedCache locks put/evict:
 # fitMultiple's parallel fan-out reaches this from worker threads.
-_STEP_CACHE: Dict[tuple, "TrainStep"] = {}
-_STEP_CACHE_CAP = 16
+from sparkdl_tpu.utils.cache import BoundedCache
 
-import threading as _threading
-
-_STEP_CACHE_LOCK = _threading.Lock()
-
-
-def _step_cache_put(key, value) -> None:
-    with _STEP_CACHE_LOCK:
-        while len(_STEP_CACHE) >= _STEP_CACHE_CAP:
-            _STEP_CACHE.pop(next(iter(_STEP_CACHE)), None)
-        _STEP_CACHE[key] = value
+_STEP_CACHE = BoundedCache(cap=16)
 
 
 def clear_train_step_cache() -> None:
-    with _STEP_CACHE_LOCK:
-        _STEP_CACHE.clear()
+    _STEP_CACHE.clear()
     _OPT_INSTANCES.clear()
 
 
@@ -157,18 +155,53 @@ def _mesh_key(mesh) -> tuple:
             tuple(mesh.devices.shape))
 
 
+def resolve_param_specs(param_specs, params, mesh):
+    """``param_specs`` -> a pytree of NamedSharding matching ``params``.
+
+    Accepts a pytree of ``PartitionSpec`` (same structure as params) or a
+    callable ``(path_str, leaf) -> PartitionSpec`` applied per leaf — the
+    rule form used for tensor-parallel layouts (e.g. shard only the
+    classifier head's kernel on the ``model`` axis)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if callable(param_specs):
+        def rule(path, leaf):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            return NamedSharding(mesh, param_specs(name, leaf))
+
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        return jax.tree_util.tree_unflatten(
+            flat[1], [rule(p, l) for p, l in flat[0]])
+    # PartitionSpec subclasses tuple — stop tree traversal at specs
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_specs,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
 def make_train_step(predict_fn: Callable, loss, optimizer,
-                    mesh=None, cache: bool = True) -> TrainStep:
+                    mesh=None, cache: bool = True,
+                    param_specs=None, params_template=None) -> TrainStep:
     """Build (or fetch the cached) jit-compiled data-parallel train step.
 
     ``predict_fn(params, x) -> pred``; ``loss(pred, y) -> [B]``;
     ``optimizer`` is an optax GradientTransformation.  The mean over the
     global batch is what makes XLA emit the cross-chip gradient psum.
-    """
+
+    ``param_specs`` (with ``params_template``) enables TENSOR PARALLELISM:
+    a pytree of ``PartitionSpec`` (or a ``(path, leaf) -> PartitionSpec``
+    rule) sharding chosen parameters over the mesh's ``model`` axis —
+    XLA's SPMD partitioner then inserts the activation/gradient
+    collectives the layout implies.  The zoo's CNNs don't need TP
+    (SURVEY.md §2); the path exists for oversized heads/embeddings and is
+    exercised by the driver's multi-chip dryrun.  TP steps are not
+    cached (their key would depend on the spec tree)."""
     import jax
     import jax.numpy as jnp
 
     mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+    if param_specs is not None:
+        cache = False
     key = (id(predict_fn),
            loss if isinstance(loss, str) else id(loss),
            id(optimizer), _mesh_key(mesh))
@@ -192,15 +225,35 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
         params = optax.apply_updates(params, updates)
         return params, opt_state, lval
 
-    step_fn = jax.jit(
-        step,
-        in_shardings=(replicated, replicated, batch_sharded, batch_sharded),
-        out_shardings=(replicated, replicated, replicated),
-        donate_argnums=(0, 1))
+    param_shardings = None
+    if param_specs is not None:
+        if params_template is None:
+            raise ValueError(
+                "param_specs requires params_template (the params pytree "
+                "the spec rule/tree is resolved against)")
+        param_shardings = resolve_param_specs(param_specs, params_template,
+                                              mesh)
+        # Shardings committed on the inputs drive the partitioner; the
+        # loss stays replicated.  opt_state/output shardings propagate
+        # from the params (mu/nu mirror the param layouts).
+        step_fn = jax.jit(
+            step,
+            in_shardings=(param_shardings, None, batch_sharded,
+                          batch_sharded),
+            out_shardings=(param_shardings, None, replicated),
+            donate_argnums=(0, 1))
+    else:
+        step_fn = jax.jit(
+            step,
+            in_shardings=(replicated, replicated, batch_sharded,
+                          batch_sharded),
+            out_shardings=(replicated, replicated, replicated),
+            donate_argnums=(0, 1))
     result = TrainStep(step_fn=step_fn, mesh=mesh, replicated=replicated,
-                       batch_sharded=batch_sharded)
+                       batch_sharded=batch_sharded,
+                       param_shardings=param_shardings)
     if cache:
-        _step_cache_put(key, result)
+        _STEP_CACHE.put(key, result)
     return result
 
 
@@ -280,7 +333,7 @@ def make_train_step_with_stats(train_fn: Callable, loss, optimizer,
                                 replicated=replicated,
                                 batch_sharded=batch_sharded)
     if cache:
-        _step_cache_put(key, result)
+        _STEP_CACHE.put(key, result)
     return result
 
 
@@ -525,7 +578,12 @@ def fit_data_parallel_stream(predict_fn: Callable, params,
             losses.append(lval)
         if not losses:
             raise ValueError("epoch_source yielded no rows")
-        mean = float(np.mean([float(l) for l in losses]))
+        step_losses = [float(l) for l in losses]
+        mean = float(np.mean(step_losses))
+        if not np.isfinite(mean):
+            from sparkdl_tpu.utils import debug as _debug
+
+            _debug.warn_or_raise_nonfinite_loss(step_losses, epoch)
         epoch_losses.append(mean)
         metrics.record_time("epoch_loss", mean)
         if ckptr is not None and ckptr.due(epoch + 1) and ckptr.is_writer():
@@ -660,7 +718,12 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
             else:
                 params, opt_state, lval = step(params, opt_state, bx_d, by_d)
             losses.append(lval)
-        mean = float(np.mean([float(l) for l in losses]))
+        step_losses = [float(l) for l in losses]
+        mean = float(np.mean(step_losses))
+        if not np.isfinite(mean):
+            from sparkdl_tpu.utils import debug as _debug
+
+            _debug.warn_or_raise_nonfinite_loss(step_losses, epoch)
         epoch_losses.append(mean)
         metrics.record_time("epoch_loss", mean)
         if ckptr is not None and ckptr.due(epoch + 1) and ckptr.is_writer():

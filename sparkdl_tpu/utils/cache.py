@@ -1,0 +1,38 @@
+"""Thread-safe bounded FIFO cache for compiled-program registries.
+
+One implementation for the train-step and engine jit caches: get is
+lock-free (GIL-atomic dict read — a stale miss only costs a recompile),
+put/clear lock so concurrent workers (fitMultiple's mesh-slice fan-out)
+cannot race the eviction loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class BoundedCache:
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self._data: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key) -> Optional[Any]:
+        return self._data.get(key)
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            while len(self._data) >= self.cap:
+                self._data.pop(next(iter(self._data)), None)
+            self._data[key] = value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data

@@ -1,0 +1,108 @@
+"""Numerical-safety instrumentation (opt-in).
+
+SURVEY.md §5 "race detection / sanitizers": the reference had none in-repo
+(its closest analog was ``IsolatedSession`` preventing global-graph
+pollution); JAX's functional model removes that bug class, so the analog
+worth shipping is NUMERICAL sanitizing — the silent failure mode of
+accelerator training:
+
+* ``enable_nan_checks()`` — turns on ``jax_debug_nans``: any NaN produced
+  inside a jitted program re-runs the offending op eagerly and raises at
+  the op that made it (XLA's equivalent of a sanitizer stack trace).
+* ``warn_or_raise_nonfinite_loss(step_losses, epoch)`` — what the train
+  loops call at each EPOCH boundary (per-step host syncs would stall the
+  dispatch pipeline): raises naming the first diverged step when checks
+  are enabled, warns otherwise.  For op-level localization within the
+  step, enable_nan_checks().
+* ``check_finite(tree)`` — host-side assert over any pytree (params,
+  gradients, features) for ad-hoc use.
+* ``checks_enabled()`` — gated by ``enable_checks()`` or the
+  ``SPARKDL_DEBUG_NANS=1`` environment variable (set it before launching;
+  no code change needed).
+
+Donation safety: the train steps donate params/opt_state buffers
+(``donate_argnums``); with checks enabled the loop also verifies donated
+inputs are not re-read after the step — jax already errors on access to a
+donated buffer, so the check here is simply that the error surfaces
+instead of being swallowed (nothing to do beyond not catching it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_ENABLED: bool = False
+
+
+def checks_enabled() -> bool:
+    return _ENABLED or os.environ.get("SPARKDL_DEBUG_NANS", "") not in (
+        "", "0", "false", "False")
+
+
+def enable_checks(nan_debug: bool = True) -> None:
+    """Turn on numerical checks for this process.
+
+    ``nan_debug=True`` additionally flips ``jax_debug_nans`` — precise
+    NaN localization at ~2x step cost; leave False to keep only the cheap
+    per-step finite-loss assertion."""
+    global _ENABLED
+    _ENABLED = True
+    if nan_debug:
+        enable_nan_checks()
+
+
+def disable_checks() -> None:
+    global _ENABLED
+    _ENABLED = False
+    import jax
+
+    jax.config.update("jax_debug_nans", False)
+
+
+def enable_nan_checks() -> None:
+    import jax
+
+    jax.config.update("jax_debug_nans", True)
+    logger.info("jax_debug_nans enabled: NaNs raise at the producing op")
+
+
+def warn_or_raise_nonfinite_loss(step_losses, epoch: int) -> None:
+    """Epoch-boundary divergence check for the train loops.
+
+    ``step_losses``: the epoch's per-step losses as host floats.  Raises
+    (checks enabled) naming the first non-finite step, or warns."""
+    import numpy as np
+
+    arr = np.asarray(step_losses, dtype=np.float64)
+    if arr.size == 0 or np.isfinite(arr).all():
+        return
+    first_bad = int(np.nonzero(~np.isfinite(arr))[0][0])
+    msg = (f"non-finite loss at epoch {epoch + 1} (first at step "
+           f"{first_bad + 1}/{arr.size})")
+    if checks_enabled():
+        raise FloatingPointError(
+            msg + "; utils.debug.enable_nan_checks() localizes the "
+                  "producing op")
+    logger.warning("%s — set SPARKDL_DEBUG_NANS=1 to fail fast", msg)
+
+
+def check_finite(tree: Any, what: str = "value") -> None:
+    """Raise FloatingPointError if any leaf holds a non-finite value."""
+    import numpy as np
+
+    import jax
+
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append("/".join(str(k) for k in path) or "<root>")
+    if bad:
+        raise FloatingPointError(
+            f"non-finite {what}: {bad[:5]}{'...' if len(bad) > 5 else ''} "
+            f"(enable_nan_checks() localizes the producing op)")

@@ -1,0 +1,85 @@
+"""Retry orchestration for fits.
+
+SURVEY.md §5 "failure detection / elastic": the reference delegated failure
+recovery to Spark task retry (idempotent per-paramMap tasks, straggler
+re-execution).  The TPU analog is retry-at-the-orchestration-layer composed
+with the framework's epoch-granular checkpointing: a fit configured with
+``fitParams={"checkpoint_dir": ...}`` resumes at the last saved epoch, so a
+retried fit repeats only the epoch that failed — the same
+unit-of-reexecution economics as a retried Spark task.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple, Type
+
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# Deterministic failures: retrying re-trains to the identical error.
+# FloatingPointError is the SPARKDL_DEBUG_NANS fail-fast — retrying it
+# would re-diverge max_retries times, defeating the flag; ValueError /
+# TypeError are param/shape validation.
+NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    FloatingPointError, ValueError, TypeError)
+
+
+def with_retries(fn: Callable[[], Any], *, max_retries: int = 2,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 non_retryable: Tuple[Type[BaseException], ...]
+                 = NON_RETRYABLE,
+                 backoff_seconds: float = 0.0,
+                 on_retry: Optional[Callable[[int, BaseException], None]]
+                 = None) -> Any:
+    """Run ``fn()`` with up to ``max_retries`` re-executions.
+
+    ``KeyboardInterrupt``/``SystemExit`` always propagate, as does
+    anything in ``non_retryable`` (deterministic failures — see
+    NON_RETRYABLE; pass ``non_retryable=()`` to retry everything).
+    ``on_retry`` (attempt_index, exception) runs before each re-execution
+    — the hook for external health checks or device re-initialization.
+    """
+    attempts = max(0, int(max_retries)) + 1
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except non_retryable:
+            raise
+        except retry_on as e:
+            last = e
+            if attempt == attempts - 1:
+                break
+            logger.warning("attempt %d/%d failed (%s: %s); retrying",
+                           attempt + 1, attempts, type(e).__name__, e)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if backoff_seconds:
+                time.sleep(backoff_seconds * (2 ** attempt))
+    assert last is not None
+    raise last
+
+
+def fit_with_retries(estimator, dataset, params=None, *,
+                     max_retries: int = 2,
+                     non_retryable: Tuple[Type[BaseException], ...]
+                     = NON_RETRYABLE,
+                     backoff_seconds: float = 0.0,
+                     on_retry: Optional[Callable] = None):
+    """``estimator.fit(dataset, params)`` with retry orchestration.
+
+    Pair with ``fitParams={"checkpoint_dir": ...}`` so each retry RESUMES
+    from the newest epoch checkpoint instead of restarting: transient
+    failures (preemption, host OOM, flaky storage) then cost one epoch of
+    recompute.  Without a checkpoint_dir each retry restarts the fit from
+    scratch (still correct — fits are idempotent like the reference's
+    Spark tasks — just more expensive).
+    """
+    return with_retries(lambda: estimator.fit(dataset, params),
+                        max_retries=max_retries,
+                        non_retryable=non_retryable,
+                        backoff_seconds=backoff_seconds,
+                        on_retry=on_retry)

@@ -1,0 +1,145 @@
+"""Numerical sanitizing + retry orchestration (SURVEY.md §5 analogs)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.utils import debug, retry
+
+
+def test_check_finite_flags_bad_leaves():
+    debug.check_finite({"a": np.ones(3), "b": {"c": np.zeros(2)}})  # ok
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        debug.check_finite({"a": np.asarray([1.0, np.nan])})
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        debug.check_finite({"w": np.asarray([np.inf], np.float32)})
+    # integer leaves can't be non-finite; must not crash
+    debug.check_finite({"i": np.asarray([1, 2, 3])})
+
+
+def test_checks_enabled_env_and_api(monkeypatch):
+    monkeypatch.delenv("SPARKDL_DEBUG_NANS", raising=False)
+    debug.disable_checks()
+    assert not debug.checks_enabled()
+    monkeypatch.setenv("SPARKDL_DEBUG_NANS", "1")
+    assert debug.checks_enabled()
+    monkeypatch.delenv("SPARKDL_DEBUG_NANS")
+    debug.enable_checks(nan_debug=False)
+    assert debug.checks_enabled()
+    debug.disable_checks()
+
+
+def test_nonfinite_loss_fails_fast_when_enabled(rng):
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.parallel.train import fit_data_parallel
+
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = rng.normal(size=(16, 1)).astype(np.float32)
+
+    def predict(p, xb):
+        # divides by ~0 after the first update -> NaN loss
+        return jnp.asarray(xb) @ p["w"] / jnp.sum(p["w"]) * jnp.nan
+
+    params = {"w": np.ones((4, 1), np.float32)}
+    debug.enable_checks(nan_debug=False)
+    try:
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            fit_data_parallel(predict, params, x, y,
+                              optimizer=optax.sgd(0.1), loss="mse",
+                              batch_size=8, epochs=2)
+    finally:
+        debug.disable_checks()
+
+
+def test_with_retries_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    seen = []
+    out = retry.with_retries(flaky, max_retries=3,
+                             on_retry=lambda i, e: seen.append((i, str(e))))
+    assert out == "ok"
+    assert len(calls) == 3
+    assert seen == [(0, "transient"), (1, "transient")]
+
+
+def test_with_retries_exhausts_and_raises():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise RuntimeError("persistent")
+
+    with pytest.raises(RuntimeError, match="persistent"):
+        retry.with_retries(always, max_retries=1)
+    assert len(calls) == 2  # initial + 1 retry
+
+
+def test_with_retries_deterministic_failures_not_retried():
+    """FloatingPointError (the SPARKDL_DEBUG_NANS fail-fast) and
+    validation errors must surface immediately — re-training a diverged
+    fit max_retries times defeats the debug flag."""
+    calls = []
+
+    def diverged():
+        calls.append(1)
+        raise FloatingPointError("non-finite loss")
+
+    with pytest.raises(FloatingPointError):
+        retry.with_retries(diverged, max_retries=3)
+    assert len(calls) == 1
+    calls.clear()
+
+    def bad_params():
+        calls.append(1)
+        raise ValueError("requires params")
+
+    with pytest.raises(ValueError):
+        retry.with_retries(bad_params, max_retries=3)
+    assert len(calls) == 1
+
+
+def test_fit_with_retries_resumes_from_checkpoint(tmp_path, fixture_images):
+    """A fit that dies mid-run is retried and RESUMES at the last epoch
+    checkpoint: the completed run's total trained epochs equal the
+    requested count, with the pre-crash epochs not re-trained."""
+    from sparkdl_tpu.estimators import ImageFileEstimator
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    import jax.numpy as jnp
+
+    paths = fixture_images["paths"] * 4
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+              for i in range(len(paths))]
+    df = DataFrame({"uri": paths, "label": labels})
+    fails = {"left": 1}
+
+    def loader(uri):
+        from PIL import Image
+
+        if fails["left"] > 0 and uri.endswith("img_2.jpg"):
+            fails["left"] -= 1
+            raise OSError("simulated flaky storage")
+        img = Image.open(uri).convert("RGB").resize((8, 8))
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    rng2 = np.random.default_rng(0)
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=ModelFunction(
+            fn=lambda v, x: jnp.asarray(x).reshape(x.shape[0], -1) @ v["w"],
+            variables={"w": rng2.normal(0, 0.01, (192, 2)
+                                        ).astype(np.float32)}),
+        imageLoader=loader, optimizer="sgd", loss="mse",
+        fitParams={"epochs": 3,
+                   "checkpoint_dir": str(tmp_path / "ck")}, batchSize=8)
+    model = retry.fit_with_retries(est, df, max_retries=2)
+    assert fails["left"] == 0  # the failure DID happen
+    assert len(model.trainLosses) == 3
