@@ -108,16 +108,30 @@ class CrossValidator(Estimator):
     def __init__(self, estimator: Optional[Estimator] = None,
                  estimatorParamMaps: Optional[List[Dict]] = None,
                  evaluator: Optional[Evaluator] = None,
-                 numFolds: int = 3, seed: int = 0):
+                 numFolds: int = 3, seed: int = 0, parallelism: int = 1):
         super().__init__()
         self.estimator = estimator
         self.estimatorParamMaps = estimatorParamMaps
         self.evaluator = evaluator
         self.numFolds = int(numFolds)
         self.seed = int(seed)
+        # pyspark.ml.tuning parity: how many param-map fits may run
+        # concurrently.  Forwarded to the estimator's own `parallelism`
+        # param when it has one (ImageFileEstimator fans maps out over
+        # device-mesh slices); estimators without the param fit
+        # sequentially as before.
+        self.parallelism = int(parallelism)
+
+    def _effective_estimator(self) -> Estimator:
+        est = self.estimator
+        if (self.parallelism > 1 and hasattr(est, "hasParam")
+                and est.hasParam("parallelism")):
+            return est.copy({est.getParam("parallelism"): self.parallelism})
+        return est
 
     def _fit(self, dataset) -> CrossValidatorModel:
-        est, maps, ev = self.estimator, self.estimatorParamMaps, self.evaluator
+        est, maps, ev = (self._effective_estimator(),
+                         self.estimatorParamMaps, self.evaluator)
         if est is None or not maps or ev is None:
             raise ValueError(
                 "CrossValidator requires estimator, estimatorParamMaps and "
@@ -152,16 +166,21 @@ class TrainValidationSplit(Estimator):
     def __init__(self, estimator: Optional[Estimator] = None,
                  estimatorParamMaps: Optional[List[Dict]] = None,
                  evaluator: Optional[Evaluator] = None,
-                 trainRatio: float = 0.75, seed: int = 0):
+                 trainRatio: float = 0.75, seed: int = 0,
+                 parallelism: int = 1):
         super().__init__()
         self.estimator = estimator
         self.estimatorParamMaps = estimatorParamMaps
         self.evaluator = evaluator
         self.trainRatio = float(trainRatio)
         self.seed = int(seed)
+        self.parallelism = int(parallelism)
+
+    _effective_estimator = CrossValidator._effective_estimator
 
     def _fit(self, dataset) -> CrossValidatorModel:
-        est, maps, ev = self.estimator, self.estimatorParamMaps, self.evaluator
+        est, maps, ev = (self._effective_estimator(),
+                         self.estimatorParamMaps, self.evaluator)
         if est is None or not maps or ev is None:
             raise ValueError(
                 "TrainValidationSplit requires estimator, estimatorParamMaps "

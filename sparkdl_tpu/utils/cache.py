@@ -23,6 +23,11 @@ class BoundedCache:
 
     def put(self, key, value) -> None:
         with self._lock:
+            if key in self._data:
+                # racing double-compile of the same key: overwrite in
+                # place, never evict an unrelated live entry for it
+                self._data[key] = value
+                return
             while len(self._data) >= self.cap:
                 self._data.pop(next(iter(self._data)), None)
             self._data[key] = value

@@ -37,6 +37,7 @@ from sparkdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _ENABLED: bool = False
+_NAN_DEBUG_SET_BY_US: bool = False
 
 
 def checks_enabled() -> bool:
@@ -57,17 +58,23 @@ def enable_checks(nan_debug: bool = True) -> None:
 
 
 def disable_checks() -> None:
-    global _ENABLED
+    """Turn checks off; resets ``jax_debug_nans`` only if THIS module set
+    it (a user's own jax.config setting is never clobbered)."""
+    global _ENABLED, _NAN_DEBUG_SET_BY_US
     _ENABLED = False
-    import jax
+    if _NAN_DEBUG_SET_BY_US:
+        import jax
 
-    jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_debug_nans", False)
+        _NAN_DEBUG_SET_BY_US = False
 
 
 def enable_nan_checks() -> None:
+    global _NAN_DEBUG_SET_BY_US
     import jax
 
     jax.config.update("jax_debug_nans", True)
+    _NAN_DEBUG_SET_BY_US = True
     logger.info("jax_debug_nans enabled: NaNs raise at the producing op")
 
 

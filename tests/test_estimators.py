@@ -623,3 +623,37 @@ def test_tensor_parallel_head_matches_replicated(rng):
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
         p_tp, p_rep)
+
+
+def test_cross_validator_parallelism_matches_sequential(fixture_images):
+    """CrossValidator(parallelism=k) forwards the fan-out to the
+    estimator (pyspark.ml.tuning contract); metrics and the selected
+    model must match the sequential run exactly."""
+    from sparkdl_tpu.estimators.evaluation import \
+        MulticlassClassificationEvaluator
+
+    paths = fixture_images["paths"] * 4
+    labels = [i % 2 for i in range(len(paths))]
+    df = DataFrame({"uri": paths, "label": labels})
+
+    def build_cv(par):
+        est = ImageFileEstimator(
+            inputCol="uri", outputCol="prediction", labelCol="label",
+            modelFunction=_tiny_trainable_mf(),
+            imageLoader=_loader, optimizer="sgd",
+            loss="sparse_categorical_crossentropy",
+            fitParams={"epochs": 1, "shuffle": False}, batchSize=8)
+        maps = [{est.batchSize: 8}, {est.batchSize: 12}]
+        ev = MulticlassClassificationEvaluator(labelCol="label",
+                                               predictionCol="prediction")
+        return CrossValidator(estimator=est, estimatorParamMaps=maps,
+                              evaluator=ev, numFolds=2, parallelism=par)
+
+    m_seq = build_cv(1).fit(df)
+    m_par = build_cv(2).fit(df)
+    np.testing.assert_allclose(m_seq.avgMetrics, m_par.avgMetrics,
+                               rtol=1e-6)
+    a = m_seq.bestModel.transform(df).collect()
+    b = m_par.bestModel.transform(df).collect()
+    for ra, rb in zip(a, b):
+        np.testing.assert_allclose(ra["prediction"], rb["prediction"])

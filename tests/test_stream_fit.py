@@ -166,3 +166,35 @@ def test_estimator_fit_stream(fixture_images):
     df = DataFrame({"uri": paths, "label": labels})
     rows = model.transform(df).collect()
     assert all(len(r["preds"]) == 2 for r in rows)
+
+
+def test_stream_fit_with_train_batch_stats(fixture_images):
+    """The streaming fit path supports trainBatchStats through the shared
+    runner: BatchNorm statistics update during a stream-sourced fit."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.estimators import ImageFileEstimator
+    from tests.test_estimators import _bn_model_function, _loader
+
+    mf = _bn_model_function()
+    before = np.asarray(mf.variables["batch_stats"]["bn"]["mean"]).copy()
+    paths = fixture_images["paths"] * 4
+    labels = [[1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+              for i in range(len(paths))]
+
+    def source():
+        for off in range(0, len(paths), 6):
+            yield pa.record_batch({
+                "uri": pa.array(paths[off:off + 6]),
+                "label": pa.array(labels[off:off + 6]),
+            })
+
+    est = ImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFunction=mf, imageLoader=_loader, optimizer="sgd",
+        loss="categorical_crossentropy", trainBatchStats=True,
+        fitParams={"epochs": 2}, batchSize=8)
+    model = est.fit(lambda: source())
+    after = np.asarray(
+        model.getModelFunction().variables["batch_stats"]["bn"]["mean"])
+    assert not np.allclose(before, after)
