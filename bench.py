@@ -47,13 +47,24 @@ STEPS = int(os.environ.get("SPARKDL_BENCH_STEPS", "20"))
 DTYPE = os.environ.get("SPARKDL_BENCH_DTYPE", "bfloat16")
 
 
+_LINES = {}
+_LAST_PRINTED = [None]
+
+
+def _print_line(line):
+    _LAST_PRINTED[0] = line
+    print(line, flush=True)
+
+
 def emit(config, metric, value, unit, vs_baseline=None):
-    print(json.dumps({
+    line = json.dumps({
         "config": config, "metric": metric, "value": round(float(value), 2),
         "unit": unit,
         "vs_baseline": (round(float(vs_baseline), 3)
                         if vs_baseline is not None else None),
-    }), flush=True)
+    })
+    _LINES[config] = line
+    _print_line(line)
 
 
 def _compute_dtype():
@@ -311,8 +322,11 @@ BENCHES = {
 
 
 def main():
-    # headline ("1") last so the driver's final-line parse tracks it
-    default = "1e2e,2,3,4,5,1"
+    # Headline ("1") runs FIRST — if the driver times the suite out
+    # mid-run, the tracked metric is already on stdout — and its line is
+    # RE-EMITTED last so a parse-the-final-line driver still sees it on a
+    # complete run.
+    default = "1,1e2e,2,3,4,5"
     wanted = os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")
     for key in wanted:
         key = key.strip()
@@ -322,8 +336,11 @@ def main():
         try:
             fn()
         except Exception as e:  # one failing config must not kill the rest
-            print(json.dumps({"config": key, "error": repr(e)[:300]}),
-                  flush=True)
+            _print_line(json.dumps({"config": key, "error": repr(e)[:300]}))
+    # a parse-the-final-line driver must end on the headline metric
+    # whenever it was measured (even if later configs errored)
+    if "1" in _LINES and _LAST_PRINTED[0] != _LINES["1"]:
+        _print_line(_LINES["1"])
 
 
 if __name__ == "__main__":

@@ -73,8 +73,11 @@ def enable_nan_checks() -> None:
     global _NAN_DEBUG_SET_BY_US
     import jax
 
-    jax.config.update("jax_debug_nans", True)
-    _NAN_DEBUG_SET_BY_US = True
+    if not jax.config.jax_debug_nans:
+        # only claim ownership if WE flipped it: a user's own pre-existing
+        # setting must survive a later disable_checks()
+        jax.config.update("jax_debug_nans", True)
+        _NAN_DEBUG_SET_BY_US = True
     logger.info("jax_debug_nans enabled: NaNs raise at the producing op")
 
 
