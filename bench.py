@@ -26,9 +26,10 @@ era-typical single-V100 TF-1.x InceptionV3 batch-inference rate (~875
 images/sec/GPU) implied by the north-star's 8xV100 comparison cluster.
 Non-image-throughput lines report vs_baseline null.
 
-Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default "1e2e,2,3,4,5,1"),
-SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
-(bfloat16|float32).
+Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default "1,1e2e,2,3,4,5" —
+headline first so a timed-out run still printed it; it is re-emitted last
+on completion), SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20),
+SPARKDL_BENCH_DTYPE (bfloat16|float32).
 """
 
 from __future__ import annotations

@@ -198,6 +198,31 @@ class DataFrame:
         else:
             yield from self._table.to_batches(max_chunksize=batch_size)
 
+    def map_blocks(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
+                   batch_size: int = 1024) -> "DataFrame":
+        """Block-wise map: ``fn`` receives one arrow RecordBatch at a time
+        and returns a RecordBatch (column layout may change).
+
+        The vectorized counterpart of the reference's TensorFrames
+        ``map_blocks`` executor path (``tensorframes.map_blocks`` —
+        SURVEY.md §2 C11 ``blocked=True``): no per-row Python objects —
+        ``fn`` works on columnar data.  The first output batch pins the
+        schema."""
+        out: List[pa.RecordBatch] = []
+        schema: Optional[pa.Schema] = None
+        for rb in self.iter_batches(batch_size):
+            res = fn(rb)
+            if not isinstance(res, pa.RecordBatch):
+                raise TypeError(
+                    f"map_blocks fn must return a pyarrow.RecordBatch, got "
+                    f"{type(res).__name__}")
+            if schema is None:
+                schema = res.schema
+            out.append(res)
+        if schema is None:
+            return DataFrame.from_rows([])
+        return DataFrame(pa.Table.from_batches(out, schema=schema))
+
     def map_rows(self, fn: Callable[[Row], dict],
                  batch_size: int = 1024) -> "DataFrame":
         """Row-wise map producing a new frame (host-side; used for cheap

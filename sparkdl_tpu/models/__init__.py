@@ -162,6 +162,7 @@ _registry = _Registry()
 def _populate():
     from sparkdl_tpu.models.inception import (InceptionV3,
                                               inception_import_order)
+    from sparkdl_tpu.models.mobilenet import MobileNetV2
     from sparkdl_tpu.models.resnet import ResNet50
     from sparkdl_tpu.models.vgg import VGG16, VGG19
     from sparkdl_tpu.models.xception import Xception, xception_auto_order
@@ -183,6 +184,11 @@ def _populate():
         name="InceptionV3", module_builder=InceptionV3, input_size=(299, 299),
         feature_size=2048, preprocess_mode="tf", keras_app="InceptionV3"),
         inception_import_order)
+    # Beyond the reference's five: edge-class backbone (see mobilenet.py).
+    _registry.register(ModelSpec(
+        name="MobileNetV2", module_builder=MobileNetV2,
+        input_size=(224, 224), feature_size=1280, preprocess_mode="tf",
+        keras_app="MobileNetV2"))
 
 
 _populate()

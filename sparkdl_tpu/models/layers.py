@@ -27,6 +27,26 @@ BN_EPS_DEFAULT = 1e-3
 BN_MOMENTUM_DEFAULT = 0.99
 
 
+def _depthwise_conv(x: jnp.ndarray, dw: jnp.ndarray, strides, padding,
+                    dtype) -> jnp.ndarray:
+    """Apply a Keras-layout depthwise kernel [H,W,Cin,mult] via lax.
+
+    The Keras depthwise output channel (c, m) -> c*mult + m equals a
+    C-major reshape to [H,W,1,Cin*mult], which is exactly lax's
+    grouped-conv kernel layout (feature_group_count=Cin) — the one subtle
+    layout fact both depthwise modules depend on, kept in one place."""
+    import jax.lax as lax
+
+    kh, kw, cin, mult = dw.shape
+    dw_lax = dw.reshape(kh, kw, 1, cin * mult)
+    return lax.conv_general_dilated(
+        jnp.asarray(x, dtype), jnp.asarray(dw_lax, dtype),
+        window_strides=strides,
+        padding=padding,
+        feature_group_count=cin,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 class SeparableConv2D(nn.Module):
     """Depthwise-separable conv matching ``keras.layers.SeparableConv2D``.
 
@@ -57,19 +77,10 @@ class SeparableConv2D(nn.Module):
             "pointwise_kernel",
             nn.initializers.lecun_normal(),
             (1, 1, cin * self.depth_multiplier, self.features))
-        # Keras depthwise output channel (c, m) -> c*mult + m equals a C-major
-        # reshape, which is exactly lax's grouped-conv kernel layout.
-        dw_lax = dw.reshape(kh, kw, 1, cin * self.depth_multiplier)
         dtype = self.dtype or x.dtype
-        y = jnp.asarray(x, dtype)
         import jax.lax as lax
 
-        y = lax.conv_general_dilated(
-            y, jnp.asarray(dw_lax, dtype),
-            window_strides=self.strides,
-            padding=self.padding,
-            feature_group_count=cin,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = _depthwise_conv(x, dw, self.strides, self.padding, dtype)
         y = lax.conv_general_dilated(
             y, jnp.asarray(pw, dtype),
             window_strides=(1, 1),
@@ -77,6 +88,37 @@ class SeparableConv2D(nn.Module):
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             b = self.param("bias", nn.initializers.zeros, (self.features,))
+            y = y + jnp.asarray(b, dtype)
+        return y
+
+
+class DepthwiseConv2D(nn.Module):
+    """Depthwise conv matching ``keras.layers.DepthwiseConv2D``.
+
+    Param layout mirrors Keras (``depthwise_kernel`` [H,W,Cin,mult], the
+    importer's ``depthconv`` kind); lowered as a grouped conv
+    (feature_group_count=Cin)."""
+
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    depth_multiplier: int = 1
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cin = x.shape[-1]
+        kh, kw = self.kernel_size
+        dw = self.param(
+            "depthwise_kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.depth_multiplier))
+        dtype = self.dtype or x.dtype
+        y = _depthwise_conv(x, dw, self.strides, self.padding, dtype)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (cin * self.depth_multiplier,))
             y = y + jnp.asarray(b, dtype)
         return y
 

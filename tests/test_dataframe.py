@@ -103,3 +103,28 @@ def test_map_rows_schema_promotion():
         lambda r: {"b": None if r["a"] < 3 else float(r["a"])}, batch_size=2)
     assert [r["b"] for r in out2.collect()] == [None, None, 3.0, 4.0]
     assert out2.table.column("b").type == pa.float64()
+
+
+def test_map_blocks_columnar():
+    """Block-wise map (TensorFrames map_blocks parity): fn sees record
+    batches, never per-row Python objects, and may change the layout."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    df = DataFrame(pa.table({"a": list(range(10)),
+                             "b": [float(v) for v in range(10)]}))
+    seen_sizes = []
+
+    def double(rb):
+        seen_sizes.append(rb.num_rows)
+        return pa.record_batch({
+            "a2": pc.multiply(rb.column(0), 2),
+            "b": rb.column(1),
+        })
+
+    out = df.map_blocks(double, batch_size=4)
+    assert out.columns == ["a2", "b"]
+    assert [r["a2"] for r in out.collect()] == [2 * v for v in range(10)]
+    assert seen_sizes == [4, 4, 2]
+    with pytest.raises(TypeError, match="RecordBatch"):
+        df.map_blocks(lambda rb: rb.to_pylist())
