@@ -31,7 +31,6 @@ from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
 from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
                                       HasLabelCol, HasOutputCol)
-from sparkdl_tpu.parallel.engine import InferenceEngine
 from sparkdl_tpu.parallel.train import fit_data_parallel
 from sparkdl_tpu.transformers.base import Estimator, Model
 from sparkdl_tpu.utils.logging import get_logger

@@ -1,13 +1,16 @@
 """Worker script for the 2-process jax.distributed integration test.
 
-Run as: python _multihost_worker.py <pid> <nproc> <port> <out.json> [ckpt_dir]
+Run as: python _multihost_worker.py <pid> <nproc> <port> <out.json>
+            [ckpt_dir] [mode]
 
 Each process gets an UNEQUAL local shard (10 vs 6 rows — the case that
 used to deadlock when steps-per-epoch derived from the local count) and
-runs a data-parallel fit through the production fit_data_parallel path:
-put_sharded's make_array_from_process_local_data branch, the global
-steps-per-epoch allgather, and (with ckpt_dir) process-0-gated checkpoint
-writes all execute for real.
+runs a data-parallel fit through the production path: put_sharded's
+make_array_from_process_local_data branch, the global steps-per-epoch
+agreement, and (with ckpt_dir) process-0-gated checkpoint writes all
+execute for real.  ``mode``: "arrays" (default, fit_data_parallel) or
+"stream" (fit_data_parallel_stream over a re-iterable chunk source with
+a pinned steps_per_epoch — the multi-controller streaming contract).
 """
 
 import json
@@ -19,7 +22,9 @@ import numpy as np
 def main():
     pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
                                   sys.argv[3], sys.argv[4])
-    ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    ckpt_dir = sys.argv[5] if len(sys.argv) > 5 and sys.argv[5] != "-" \
+        else None
+    mode = sys.argv[6] if len(sys.argv) > 6 else "arrays"
 
     import jax
 
@@ -30,7 +35,8 @@ def main():
     import optax
 
     from sparkdl_tpu.parallel import mesh as mesh_lib
-    from sparkdl_tpu.parallel.train import fit_data_parallel
+    from sparkdl_tpu.parallel.train import (fit_data_parallel,
+                                            fit_data_parallel_stream)
 
     # Unequal shards across hosts (rows % nproc != 0 overall).
     n_local = 10 if pid == 0 else 6
@@ -42,11 +48,24 @@ def main():
     def predict(p, xb):
         return jnp.asarray(xb) @ p["w"]
 
+    if mode not in ("arrays", "stream"):
+        raise ValueError(f"unknown worker mode {mode!r}")
     params = {"w": np.zeros((5, 1), np.float32)}
-    fitted, losses = fit_data_parallel(
-        predict, params, x, y, optimizer=optax.sgd(0.05), loss="mse",
-        batch_size=8, epochs=3, seed=0, mesh=mesh_lib.get_mesh(),
-        checkpoint_dir=ckpt_dir)
+    if mode == "stream":
+        def source():
+            for off in range(0, n_local, 4):  # uneven chunking per host
+                yield x[off:off + 4], y[off:off + 4]
+
+        # steps_per_epoch from the GLOBAL row count (16) / global batch (8)
+        fitted, losses = fit_data_parallel_stream(
+            predict, params, source, optimizer=optax.sgd(0.05), loss="mse",
+            batch_size=8, epochs=3, steps_per_epoch=2,
+            mesh=mesh_lib.get_mesh(), checkpoint_dir=ckpt_dir)
+    else:
+        fitted, losses = fit_data_parallel(
+            predict, params, x, y, optimizer=optax.sgd(0.05), loss="mse",
+            batch_size=8, epochs=3, seed=0, mesh=mesh_lib.get_mesh(),
+            checkpoint_dir=ckpt_dir)
 
     with open(out_path, "w") as f:
         json.dump({

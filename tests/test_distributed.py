@@ -93,14 +93,10 @@ def test_fit_goes_through_put_sharded(monkeypatch):
     assert losses[-1] < losses[0]
 
 
-@pytest.mark.slow
-def test_two_process_fit_unequal_shards(tmp_path):
-    """REAL 2-process jax.distributed integration (VERDICT r2 Missing #3):
-    two subprocesses on the CPU backend, 2 virtual devices each, UNEQUAL
-    local shards (10 vs 6 rows).  Exercises put_sharded's
-    make_array_from_process_local_data branch, the global steps-per-epoch
-    allgather (the old local-count derivation deadlocked here), and
-    process-0-gated checkpoint writes."""
+def _run_two_process_workers(tmp_path, ckpt=None, mode="arrays"):
+    """Spawn two REAL jax.distributed worker processes and return their
+    parsed result dicts (with the 2-process / 2x2-device topology
+    asserted).  Shared by the arrays- and stream-mode integration tests."""
     import json
     import os
     import socket
@@ -113,7 +109,6 @@ def test_two_process_fit_unequal_shards(tmp_path):
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(repo, "tests", "_multihost_worker.py")
-    ckpt = str(tmp_path / "ckpt")
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -125,11 +120,16 @@ def test_two_process_fit_unequal_shards(tmp_path):
         "TF_CPP_MIN_LOG_LEVEL": "2",
     })
     outs = [str(tmp_path / f"out_{i}.json") for i in range(2)]
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), "2", str(port), outs[i], ckpt],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for i in range(2)]
+    procs = []
     try:
+        # spawn INSIDE the try: a failed second Popen must still kill the
+        # first worker (otherwise it hangs forever in the coordinator
+        # handshake as an orphan)
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, str(i), "2", str(port), outs[i],
+                 ckpt or "-", mode],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
         for p in procs:
             stdout, _ = p.communicate(timeout=300)
             assert p.returncode == 0, stdout.decode(errors="replace")[-4000:]
@@ -141,9 +141,25 @@ def test_two_process_fit_unequal_shards(tmp_path):
     for path in outs:
         with open(path) as f:
             results.append(json.load(f))
+    # the topology actually formed: 2 processes x 2 local devices
     assert all(r["process_count"] == 2 for r in results)
     assert all(r["device_count"] == 4 for r in results)
     assert all(r["local_device_count"] == 2 for r in results)
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_fit_unequal_shards(tmp_path):
+    """REAL 2-process jax.distributed integration (VERDICT r2 Missing #3):
+    two subprocesses on the CPU backend, 2 virtual devices each, UNEQUAL
+    local shards (10 vs 6 rows).  Exercises put_sharded's
+    make_array_from_process_local_data branch, the global steps-per-epoch
+    allgather (the old local-count derivation deadlocked here), and
+    process-0-gated checkpoint writes."""
+    import os
+
+    ckpt = str(tmp_path / "ckpt")
+    results = _run_two_process_workers(tmp_path, ckpt=ckpt)
     # same number of collective steps -> both completed 3 epochs
     assert all(len(r["losses"]) == 3 for r in results)
     # params are replicated: every host must hold the identical fit
@@ -153,3 +169,16 @@ def test_two_process_fit_unequal_shards(tmp_path):
     # single-writer checkpointing: epochs saved exactly once (by process 0)
     saved = sorted(d for d in os.listdir(ckpt) if d.startswith("epoch_"))
     assert saved == ["epoch_000001", "epoch_000002", "epoch_000003"]
+
+
+@pytest.mark.slow
+def test_two_process_streaming_fit(tmp_path):
+    """REAL 2-process streaming fit: re-iterable chunk sources with
+    unequal per-host rows and a PINNED steps_per_epoch (the
+    multi-controller streaming contract) — both hosts complete the same
+    number of collective steps and hold identical fitted params."""
+    results = _run_two_process_workers(tmp_path, mode="stream")
+    assert all(len(r["losses"]) == 3 for r in results)
+    np.testing.assert_allclose(results[0]["w"], results[1]["w"],
+                               rtol=1e-6, atol=1e-7)
+    assert all(np.isfinite(r["losses"]).all() for r in results)
