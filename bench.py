@@ -179,7 +179,8 @@ def bench_config1_e2e():
 
 
 def bench_config2():
-    for name in ("ResNet50", "Xception", "VGG16"):
+    # MobileNetV2 is the beyond-reference zoo extension (PERF.md fleet)
+    for name in ("ResNet50", "Xception", "VGG16", "MobileNetV2"):
         fn, variables, (h, w) = _zoo_fn(name, featurize=False)
         steps = max(6, STEPS // 2)
         ips = measure_scan(fn, variables, h, w, BATCH, steps)
