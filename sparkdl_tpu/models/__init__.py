@@ -136,11 +136,15 @@ class _Registry:
     def __init__(self):
         self._specs: Dict[str, ModelSpec] = {}
         self._auto_orders: Dict[str, Callable] = {}
+        self._fixups: Dict[str, Callable] = {}
 
-    def register(self, spec: ModelSpec, auto_order_fn=None):
+    def register(self, spec: ModelSpec, auto_order_fn=None,
+                 import_fixup=None):
         self._specs[spec.name.lower()] = spec
         if auto_order_fn is not None:
             self._auto_orders[spec.name.lower()] = auto_order_fn
+        if import_fixup is not None:
+            self._fixups[spec.name.lower()] = import_fixup
 
     def get(self, name: str) -> ModelSpec:
         spec = self._specs.get(name.lower())
@@ -152,6 +156,9 @@ class _Registry:
     def auto_order_fn(self, name: str):
         return self._auto_orders.get(name.lower())
 
+    def import_fixup(self, name: str):
+        return self._fixups.get(name.lower())
+
     def names(self):
         return sorted(s.name for s in self._specs.values())
 
@@ -160,6 +167,7 @@ _registry = _Registry()
 
 
 def _populate():
+    from sparkdl_tpu.models.efficientnet import EfficientNetB0
     from sparkdl_tpu.models.inception import (InceptionV3,
                                               inception_import_order)
     from sparkdl_tpu.models.mobilenet import MobileNetV2
@@ -184,11 +192,23 @@ def _populate():
         name="InceptionV3", module_builder=InceptionV3, input_size=(299, 299),
         feature_size=2048, preprocess_mode="tf", keras_app="InceptionV3"),
         inception_import_order)
-    # Beyond the reference's five: edge-class backbone (see mobilenet.py).
+    # Beyond the reference's five: edge/efficiency-class backbones (see
+    # mobilenet.py / efficientnet.py).
     _registry.register(ModelSpec(
         name="MobileNetV2", module_builder=MobileNetV2,
         input_size=(224, 224), feature_size=1280, preprocess_mode="tf",
         keras_app="MobileNetV2"))
+    # The input Normalization layer is auto-named by keras ("normalization",
+    # "normalization_1", ... per session build count), so it imports by
+    # creation order as a fallback when the by-name match misses.
+    from sparkdl_tpu.models.efficientnet import efficientnet_import_fixup
+
+    _registry.register(ModelSpec(
+        name="EfficientNetB0", module_builder=EfficientNetB0,
+        input_size=(224, 224), feature_size=1280, preprocess_mode="none",
+        keras_app="EfficientNetB0"),
+        lambda: [("norm", ("normalization",))],
+        import_fixup=efficientnet_import_fixup)
 
 
 _populate()
@@ -208,9 +228,15 @@ def import_keras_weights(name: str, keras_model, variables: dict) -> dict:
 
     _registry.get(name)  # validate
     auto_order_fn = _registry.auto_order_fn(name)
-    return keras_import.import_weights(
+    variables = keras_import.import_weights(
         keras_model, variables,
         auto_order=auto_order_fn() if auto_order_fn else None)
+    fixup = _registry.import_fixup(name)
+    if fixup is not None:
+        # model-specific post-import hook for weightless keras layers the
+        # importer cannot see (e.g. EfficientNet's imagenet-only Rescaling)
+        variables = fixup(keras_model, variables)
+    return variables
 
 
 def load_model(name: str, weights: Optional[str] = "imagenet"):

@@ -1,0 +1,154 @@
+"""EfficientNetB0 as a flax module — a zoo extension BEYOND the reference.
+
+Like MobileNetV2 (``models/mobilenet.py``), this extends the reference's
+five-architecture registry (``python/sparkdl/transformers/named_image.py``)
+with a modern efficiency-class backbone.  Featurizer cut = global average
+pool after ``top_conv`` (1280-d).
+
+Layer names mirror ``keras.applications.EfficientNetB0`` exactly
+("stem_conv", "block1a_dwconv", "block2a_se_reduce", ..., "top_conv",
+"predictions"), so weights import BY NAME — except the input
+``Normalization`` layer, which keras auto-suffixes per session build and
+therefore also has a creation-order fallback in the registry.  Keras folds
+the input pipeline INTO the model: ``x/255``, the ``Normalization`` layer
+(mean/variance ship as weights -> the batch_stats-carrying ``InputNorm``
+submodule, importer kind "norm"), and — ONLY when built with pretrained
+imagenet weights — an extra weightless ``Rescaling(1/sqrt(std))``
+correction (upstream tf#49930 workaround), captured here as InputNorm's
+``post_scale`` stat via :func:`efficientnet_import_fixup`.  The registry's
+preprocess mode is "none" (no host-side scaling).  Stride-2 stages
+zero-pad with Keras's ``correct_pad`` then convolve VALID; activations are
+SiLU (swish); BN epsilon is the Keras default 1e-3.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import DepthwiseConv2D, global_avg_pool
+
+# Per-stage (kernel, repeats, out_channels, expand_ratio, first_stride) —
+# EfficientNet-B0 (width/depth multiplier 1.0).
+_STAGES = ((3, 1, 16, 1, 1), (3, 2, 24, 6, 2), (5, 2, 40, 6, 2),
+           (3, 3, 80, 6, 2), (5, 3, 112, 6, 1), (5, 4, 192, 6, 2),
+           (3, 1, 320, 6, 1))
+_SE_RATIO = 0.25
+
+
+def _correct_pad(x, kernel: int):
+    """Keras ``imagenet_utils.correct_pad`` for stride-2 VALID convs."""
+    adjust = (1 - x.shape[1] % 2, 1 - x.shape[2] % 2)
+    correct = (kernel // 2, kernel // 2)
+    pad = ((correct[0] - adjust[0], correct[0]),
+           (correct[1] - adjust[1], correct[1]))
+    return jnp.pad(x, ((0, 0), pad[0], pad[1], (0, 0)))
+
+
+class InputNorm(nn.Module):
+    """Keras ``Normalization`` twin: ((x - mean) / sqrt(var)) * post_scale,
+    with the dataset statistics shipped as (non-trainable) batch_stats so
+    the weight importer can fill them (kind "norm").  ``post_scale``
+    captures the weightless Rescaling correction keras inserts only in
+    imagenet-weight builds (see module docstring); it defaults to 1."""
+
+    channels: int = 3
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        mean = self.variable("batch_stats", "mean",
+                             lambda: jnp.zeros((self.channels,), jnp.float32))
+        var = self.variable("batch_stats", "var",
+                            lambda: jnp.ones((self.channels,), jnp.float32))
+        post = self.variable("batch_stats", "post_scale",
+                             lambda: jnp.ones((self.channels,), jnp.float32))
+        return (x - mean.value) / jnp.sqrt(var.value) * post.value
+
+
+def efficientnet_import_fixup(keras_model, variables: dict) -> dict:
+    """Capture keras's weightless post-Normalization ``Rescaling``.
+
+    ``EfficientNetB0(weights="imagenet")`` inserts a second Rescaling
+    layer (per-channel ``1/sqrt(IMAGENET_STDDEV_RGB)``) AFTER the
+    Normalization layer; it carries no weights, so the weight importer
+    cannot see it.  This post-import hook reads its scale into
+    InputNorm's ``post_scale`` stat; weights=None builds have no such
+    layer and keep the default 1."""
+    import numpy as np
+
+    rescalings = [l for l in keras_model.layers
+                  if type(l).__name__ == "Rescaling"]
+    if len(rescalings) < 2:
+        return variables
+    scale = np.asarray(rescalings[1].scale, dtype=np.float32).reshape(-1)
+    if scale.size == 1:
+        scale = np.repeat(scale, 3)
+    variables["batch_stats"]["normalization"]["post_scale"] = scale
+    return variables
+
+
+class EfficientNetB0(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 features: bool = False, logits: bool = False) -> jnp.ndarray:
+
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train,
+                                momentum=0.99, epsilon=1e-3, name=name)
+
+        # Input pipeline lives IN the model (keras parity): rescale then
+        # the weights-carrying normalization.
+        x = x / jnp.float32(255.0)
+        x = InputNorm(name="normalization")(x)
+
+        x = _correct_pad(x, 3)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
+                    use_bias=False, name="stem_conv")(x)
+        x = nn.silu(bn("stem_bn")(x))
+
+        for stage_idx, (k, repeats, c_out, t, s) in enumerate(_STAGES, 1):
+            for rep in range(repeats):
+                stride = s if rep == 0 else 1
+                prefix = f"block{stage_idx}{chr(ord('a') + rep)}"
+                cin = x.shape[-1]
+                inp = x
+                filters = cin * t
+                if t != 1:
+                    x = nn.Conv(filters, (1, 1), use_bias=False,
+                                name=f"{prefix}_expand_conv")(x)
+                    x = nn.silu(bn(f"{prefix}_expand_bn")(x))
+                if stride == 2:
+                    x = _correct_pad(x, k)
+                x = DepthwiseConv2D(
+                    (k, k), strides=(stride, stride),
+                    padding="SAME" if stride == 1 else "VALID",
+                    use_bias=False, name=f"{prefix}_dwconv")(x)
+                x = nn.silu(bn(f"{prefix}_bn")(x))
+                # Squeeze-and-excitation over the EXPANDED channels; the
+                # bottleneck width derives from the block INPUT channels.
+                se_filters = max(1, int(cin * _SE_RATIO))
+                se = jnp.mean(x, axis=(1, 2), keepdims=True)
+                se = nn.Conv(se_filters, (1, 1),
+                             name=f"{prefix}_se_reduce")(se)
+                se = nn.silu(se)
+                se = nn.Conv(filters, (1, 1),
+                             name=f"{prefix}_se_expand")(se)
+                x = x * nn.sigmoid(se)
+                x = nn.Conv(c_out, (1, 1), use_bias=False,
+                            name=f"{prefix}_project_conv")(x)
+                x = bn(f"{prefix}_project_bn")(x)
+                if stride == 1 and cin == c_out:
+                    # dropout ("drop_connect") is identity at inference
+                    x = x + inp
+
+        x = nn.Conv(1280, (1, 1), use_bias=False, name="top_conv")(x)
+        x = nn.silu(bn("top_bn")(x))
+        x = global_avg_pool(x)  # 1280-d featurizer cut
+        if features:
+            return x
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        if logits:
+            return x
+        return nn.softmax(x)

@@ -35,6 +35,9 @@ _WEIGHTED = {
     "BatchNormalization": "bn",
     "SeparableConv2D": "sepconv",
     "DepthwiseConv2D": "depthconv",
+    # keras.layers.Normalization (EfficientNet's in-model input pipeline):
+    # weights are [mean, variance, count]; count is bookkeeping, dropped.
+    "Normalization": "norm",
 }
 
 
@@ -112,6 +115,19 @@ def _assign(variables: dict, path: Path, kind: str, layer, weights) -> None:
         _set_in(params, path, "depthwise_kernel", weights[0])
         if len(weights) > 1:
             _set_in(params, path, "bias", weights[1])
+    elif kind == "norm":
+        mean = np.asarray(weights[0]).reshape(-1)
+        _set_in(stats, path, "mean", mean)
+        _set_in(stats, path, "var", np.asarray(weights[1]).reshape(-1))
+        node = stats
+        for k in path:
+            node = node[k]
+        if "post_scale" in node:
+            # default the weightless post-Rescaling correction to identity;
+            # a model-specific import fixup overwrites it when the keras
+            # build carries the extra layer (EfficientNet imagenet builds)
+            _set_in(stats, path, "post_scale",
+                    np.ones_like(mean, dtype=np.float32))
     else:  # pragma: no cover
         raise ValueError(f"Unknown weight kind {kind!r}")
 
@@ -171,9 +187,10 @@ def import_weights(keras_model, variables: dict,
             continue
         _assign(variables, path, kind, layer, weights)
     if not unmatched:
-        if auto_order:
-            raise ValueError(
-                "auto_order given but every keras layer matched by name")
+        # auto_order may be a FALLBACK for layers keras sometimes
+        # auto-suffixes ("normalization" vs "normalization_1" depending on
+        # how many models the session built): when every layer matched by
+        # name this round, the fallback simply wasn't needed.
         return variables
     if auto_order is None:
         raise KeyError(

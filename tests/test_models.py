@@ -33,9 +33,19 @@ def _build_keras(spec):
 
 
 def _randomize_bn(model, rng):
-    """Give BatchNorm layers non-trivial statistics so import is exercised."""
+    """Give BatchNorm (and EfficientNet's input Normalization) layers
+    non-trivial statistics so the import is exercised, not defaults."""
     for layer in model.layers:
-        if type(layer).__name__ != "BatchNormalization":
+        tname = type(layer).__name__
+        if tname == "Normalization":
+            w = layer.get_weights()
+            if w:  # [mean, variance, (count)]
+                layer.set_weights(
+                    [rng.normal(0.0, 0.1, size=w[0].shape).astype("float32"),
+                     rng.uniform(0.5, 1.5, size=w[1].shape).astype("float32")]
+                    + list(w[2:]))
+            continue
+        if tname != "BatchNormalization":
             continue
         new = []
         for w in layer.weights:
@@ -106,3 +116,64 @@ def test_preprocess_parity_vs_keras():
 def test_unknown_model_rejected():
     with pytest.raises(ValueError, match="Unknown model"):
         get_model_spec("NoSuchNet")
+
+
+def test_efficientnet_imports_across_repeated_builds():
+    """keras auto-suffixes the input Normalization layer name per session
+    build ("normalization", "normalization_1", ...); the second import in
+    one process must fall back to creation-order matching instead of
+    failing by-name (caught live by the round-3 verify drive) — and it
+    must import the right VALUES, not just shapes."""
+    rng = np.random.default_rng(5)
+    spec = get_model_spec("EfficientNetB0")
+    for _ in range(2):
+        keras_model = _build_keras(spec)
+        _randomize_bn(keras_model, rng)
+        variables = import_keras_weights(
+            "EfficientNetB0", keras_model, spec.abstract_variables())
+    norm_layer = next(l for l in keras_model.layers
+                      if type(l).__name__ == "Normalization")
+    got = variables["batch_stats"]["normalization"]
+    np.testing.assert_allclose(
+        np.asarray(got["mean"]),
+        np.asarray(norm_layer.get_weights()[0]).reshape(-1))
+    np.testing.assert_allclose(
+        np.asarray(got["var"]),
+        np.asarray(norm_layer.get_weights()[1]).reshape(-1))
+
+
+def test_efficientnet_imagenet_rescaling_fixup():
+    """EfficientNetB0(weights="imagenet") inserts a WEIGHTLESS extra
+    Rescaling(1/sqrt(std)) after Normalization (upstream tf#49930); the
+    import fixup must capture it as post_scale — and leave the default 1
+    for weights=None builds (which lack the layer)."""
+    from sparkdl_tpu.models.efficientnet import efficientnet_import_fixup
+
+    spec = get_model_spec("EfficientNetB0")
+
+    # weights=None build: single Rescaling, post_scale stays 1
+    keras_model = _build_keras(spec)
+    variables = import_keras_weights(
+        "EfficientNetB0", keras_model, spec.abstract_variables())
+    variables = efficientnet_import_fixup(keras_model, variables)
+    np.testing.assert_allclose(
+        np.asarray(variables["batch_stats"]["normalization"]["post_scale"]),
+        np.ones(3))
+
+    # simulate the imagenet build's layer list: a second Rescaling carrying
+    # the per-channel correction
+    class _FakeRescaling:
+        pass
+
+    _FakeRescaling.__name__ = "Rescaling"
+    scale = [1.0 / np.sqrt(v) for v in (0.229 ** 2, 0.224 ** 2, 0.225 ** 2)]
+    r1, r2 = _FakeRescaling(), _FakeRescaling()
+    r1.scale, r2.scale = 1.0 / 255.0, scale
+
+    class _FakeModel:
+        layers = [r1, r2]
+
+    variables = efficientnet_import_fixup(_FakeModel(), variables)
+    np.testing.assert_allclose(
+        np.asarray(variables["batch_stats"]["normalization"]["post_scale"]),
+        np.asarray(scale, np.float32), rtol=1e-6)
