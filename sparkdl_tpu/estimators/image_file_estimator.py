@@ -21,6 +21,7 @@ converted on the fly).  BatchNorm statistics stay frozen during fine-tuning
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -33,6 +34,7 @@ from sparkdl_tpu.param.shared import (CanLoadImage, HasBatchSize, HasInputCol,
                                       HasLabelCol, HasOutputCol)
 from sparkdl_tpu.parallel.train import fit_data_parallel
 from sparkdl_tpu.transformers.base import Estimator, Model
+from sparkdl_tpu.utils.cache import ByteBoundedLRU
 from sparkdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -175,25 +177,36 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         reference broadcasting the decoded arrays once).  The cache is
         keyed by the imageLoader and shared by ``copy()``d estimators
         (Params.copy shallow-copies __dict__) — exactly the fold/map
-        copies that would otherwise re-decode."""
+        copies that would otherwise re-decode.
+
+        The cache is BOUNDED (ADVICE r3: an estimator reused across
+        datasets must not accumulate every decoded image for its
+        lifetime): a byte-capped LRU, default 2048 MB, tunable via
+        ``SPARKDL_DECODE_CACHE_MB`` (0 disables caching).  CV folds /
+        param maps re-touch the same URIs, keeping them most-recent."""
         uris = dataset.table.column(self.getInputCol()).to_pylist()
         labels = dataset.table.column(self.getLabelCol()).to_pylist()
         loader = self.getImageLoader()
+        cap = int(float(os.environ.get("SPARKDL_DECODE_CACHE_MB", "2048"))
+                  * 1_000_000)
         cache = self.__dict__.get("_decode_cache")
-        if cache is None or cache[0] is not loader:
-            cache = (loader, {})
+        if cache is None or cache[0] is not loader or cache[1].cap_bytes != cap:
+            cache = (loader, ByteBoundedLRU(cap))
             self.__dict__["_decode_cache"] = cache
-        decoded = cache[1]
-        missing = [u for u in dict.fromkeys(uris) if u not in decoded]
+        lru = cache[1]
+        unique = list(dict.fromkeys(uris))
+        local = {u: lru.get(u) for u in unique}
+        missing = [u for u in unique if local[u] is None]
         if missing:
             for u, arr in zip(missing, self._decode_uris(missing, loader)):
-                decoded[u] = arr
-        x = np.stack([decoded[u] for u in uris]).astype(np.float32)
+                local[u] = arr
+                lru.put(u, arr)
+        x = np.stack([local[u] for u in uris]).astype(np.float32)
         return x, self._stack_labels(labels)
 
     def clearDecodeCache(self) -> None:
-        """Drop cached decoded images (they hold the decoded dataset in
-        host RAM until the estimator is garbage-collected)."""
+        """Drop cached decoded images (bounded while alive — see
+        ``_load_numpy`` — but freeable eagerly between datasets)."""
         self.__dict__.pop("_decode_cache", None)
 
     # -- fitting -----------------------------------------------------------

@@ -231,31 +231,35 @@ class DataFrame:
         Processed BATCH-WISE: rows of one record batch are materialized,
         mapped, and converted back to arrow before the next batch is
         touched — peak Python-object residency is O(batch_size), not the
-        table.  The schema is inferred from the first NON-EMPTY mapped
-        batch and promoted (null -> concrete, int -> float, ...) when a
-        later batch widens it — matching the old whole-table inference."""
+        table.  Each batch's schema is inferred INDEPENDENTLY and the
+        running schema is promoted (null -> concrete, int -> float, ...)
+        via ``unify_schemas`` whenever a later batch widens a column —
+        matching the old whole-table inference.  (Building later batches
+        directly against the pinned schema would silently TRUNCATE, e.g.
+        float 3.5 -> int 3, because ``from_pylist(schema=...)`` coerces
+        without raising.)"""
         out_tables: List[pa.Table] = []
         schema: Optional[pa.Schema] = None
         for rb in self.iter_batches(batch_size):
             mapped = [fn(Row(r)) for r in rb.to_pylist()]
             if not mapped:
                 continue
+            t = pa.Table.from_pylist(mapped)
             if schema is None:
-                t = pa.Table.from_pylist(mapped)
                 schema = t.schema
-            else:
-                try:
-                    t = pa.Table.from_pylist(mapped, schema=schema)
-                except pa.ArrowInvalid:
-                    # a later batch widened a column (e.g. null-typed from
-                    # the first batch, concrete now): promote and re-cast
-                    t = pa.Table.from_pylist(mapped)
-                    schema = pa.unify_schemas([schema, t.schema],
-                                              promote_options="permissive")
-                    out_tables = [prev.cast(schema) for prev in out_tables]
-                    t = t.cast(schema)
+            elif t.schema != schema:
+                schema = pa.unify_schemas([schema, t.schema],
+                                          promote_options="permissive")
             out_tables.append(t)
         if schema is None:
             return DataFrame.from_rows([])
-        return DataFrame(pa.concat_tables(
-            [t.cast(schema) for t in out_tables]))
+
+        def _conform(t: pa.Table) -> pa.Table:
+            # A batch may lack a key some other batch produced: null-fill it
+            # (the old pinned-schema behavior) before the ordered cast.
+            for field in schema:
+                if field.name not in t.column_names:
+                    t = t.append_column(field.name, pa.nulls(len(t), field.type))
+            return t.select([f.name for f in schema]).cast(schema)
+
+        return DataFrame(pa.concat_tables([_conform(t) for t in out_tables]))

@@ -88,7 +88,16 @@ def efficientnet_import_fixup(keras_model, variables: dict) -> dict:
 
 
 class EfficientNetB0(nn.Module):
+    """``drop_connect_rate`` enables keras-parity stochastic depth on the
+    residual blocks during ``train=True`` (per-block rate ramps linearly
+    ``rate * block_index / num_blocks``, per-sample noise shape, like
+    ``keras.applications`` Dropout(noise_shape=(None,1,1,1))).  Default
+    0.0 = off: inference/featurization parity is unaffected either way,
+    and fine-tuning without an rng stays valid; pass a "dropout" rng to
+    ``apply`` when enabling it (keras trains B0 with 0.2)."""
+
     num_classes: int = 1000
+    drop_connect_rate: float = 0.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False,
@@ -108,6 +117,8 @@ class EfficientNetB0(nn.Module):
                     use_bias=False, name="stem_conv")(x)
         x = nn.silu(bn("stem_bn")(x))
 
+        num_blocks = sum(st[1] for st in _STAGES)
+        block_idx = 0
         for stage_idx, (k, repeats, c_out, t, s) in enumerate(_STAGES, 1):
             for rep in range(repeats):
                 stride = s if rep == 0 else 1
@@ -140,8 +151,20 @@ class EfficientNetB0(nn.Module):
                             name=f"{prefix}_project_conv")(x)
                 x = bn(f"{prefix}_project_bn")(x)
                 if stride == 1 and cin == c_out:
-                    # dropout ("drop_connect") is identity at inference
+                    drop = self.drop_connect_rate * block_idx / num_blocks
+                    if train and drop > 0:
+                        # per-sample stochastic depth (keras Dropout with
+                        # noise_shape=(None,1,1,1)): survivors rescale.
+                        import jax
+
+                        keep = 1.0 - drop
+                        mask = jax.random.bernoulli(
+                            self.make_rng("dropout"), keep,
+                            (x.shape[0], 1, 1, 1))
+                        x = jnp.where(mask, x / jnp.float32(keep),
+                                      jnp.float32(0.0))
                     x = x + inp
+                block_idx += 1
 
         x = nn.Conv(1280, (1, 1), use_bias=False, name="top_conv")(x)
         x = nn.silu(bn("top_bn")(x))

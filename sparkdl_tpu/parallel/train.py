@@ -106,6 +106,7 @@ class TrainStep:
     replicated: Any
     batch_sharded: Any
     param_shardings: Any = None  # pytree of NamedSharding when TP is on
+    opt_shardings: Any = None    # derived from param_shardings (TP only)
 
     def put_state(self, params, opt_state):
         import jax
@@ -113,9 +114,11 @@ class TrainStep:
         if self.param_shardings is not None:
             params = jax.tree_util.tree_map(
                 jax.device_put, params, self.param_shardings)
-            # opt_state starts replicated; mu/nu layouts converge to the
-            # param shardings after the first step's output propagation.
-            return params, jax.device_put(opt_state, self.replicated)
+            # mu/nu/trace are placed under the SAME layouts the step was
+            # compiled for, so step 1 already matches the executable.
+            opt_state = jax.tree_util.tree_map(
+                jax.device_put, opt_state, self.opt_shardings)
+            return params, opt_state
         return (jax.device_put(params, self.replicated),
                 jax.device_put(opt_state, self.replicated))
 
@@ -179,6 +182,52 @@ def resolve_param_specs(param_specs, params, mesh):
         is_leaf=lambda s: isinstance(s, PartitionSpec))
 
 
+def _path_key(k) -> str:
+    for attr in ("key", "idx", "name"):
+        v = getattr(k, attr, None)
+        if v is not None:
+            return str(v)
+    return str(k)
+
+
+def resolve_opt_state_shardings(optimizer, params_template, param_shardings,
+                                replicated):
+    """Derive a sharding pytree for ``optimizer.init(params)`` from the
+    param shardings (ADVICE r3: without this, TP steps left opt_state
+    layout to the partitioner, which could re-layout mu/nu after step 1
+    and force a second compilation with mismatched donated buffers).
+
+    optax states mirror the param tree under attributes like ``mu`` /
+    ``nu`` / ``trace``: a state leaf whose path SUFFIX matches a param's
+    path (and whose shape matches) inherits that param's sharding;
+    everything else (step counts, scalars) stays replicated."""
+    import jax
+
+    param_entries = [
+        (tuple(_path_key(k) for k in path), tuple(leaf.shape), sh)
+        for (path, leaf), (_, sh) in zip(
+            jax.tree_util.tree_flatten_with_path(params_template)[0],
+            jax.tree_util.tree_flatten_with_path(param_shardings)[0])
+    ]
+    # Longest paths first: a short param path (e.g. ('bias',)) must not
+    # shadow a deeper one (('head','bias')) that matches more of the
+    # state leaf's path.
+    param_entries.sort(key=lambda e: len(e[0]), reverse=True)
+    opt_shape = jax.eval_shape(optimizer.init, params_template)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shape)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(_path_key(k) for k in path)
+        sh = replicated
+        for ppath, pshape, psh in param_entries:
+            if (len(keys) >= len(ppath) and keys[-len(ppath):] == ppath
+                    and tuple(leaf.shape) == pshape):
+                sh = psh
+                break
+        out.append(sh)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def make_train_step(predict_fn: Callable, loss, optimizer,
                     mesh=None, cache: bool = True,
                     param_specs=None, params_template=None) -> TrainStep:
@@ -234,13 +283,17 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
         param_shardings = resolve_param_specs(param_specs, params_template,
                                               mesh)
         # Shardings committed on the inputs drive the partitioner; the
-        # loss stays replicated.  opt_state/output shardings propagate
-        # from the params (mu/nu mirror the param layouts).
+        # loss stays replicated.  opt_state shardings are PINNED to mirror
+        # the param layouts (mu/nu/trace follow their param; counts stay
+        # replicated) so every step shares one executable and donation
+        # always sees the layout it compiled for.
+        opt_shardings = resolve_opt_state_shardings(
+            optimizer, params_template, param_shardings, replicated)
         step_fn = jax.jit(
             step,
-            in_shardings=(param_shardings, None, batch_sharded,
+            in_shardings=(param_shardings, opt_shardings, batch_sharded,
                           batch_sharded),
-            out_shardings=(param_shardings, None, replicated),
+            out_shardings=(param_shardings, opt_shardings, replicated),
             donate_argnums=(0, 1))
     else:
         step_fn = jax.jit(
@@ -251,7 +304,9 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
             donate_argnums=(0, 1))
     result = TrainStep(step_fn=step_fn, mesh=mesh, replicated=replicated,
                        batch_sharded=batch_sharded,
-                       param_shardings=param_shardings)
+                       param_shardings=param_shardings,
+                       opt_shardings=(opt_shardings
+                                      if param_specs is not None else None))
     if cache:
         _STEP_CACHE.put(key, result)
     return result

@@ -41,3 +41,61 @@ class BoundedCache:
 
     def __contains__(self, key) -> bool:
         return key in self._data
+
+
+class ByteBoundedLRU:
+    """Thread-safe LRU bounded by total payload BYTES (not entry count).
+
+    Backs the estimator's per-URI decode cache (ADVICE r3: unbounded
+    growth across datasets sharing a loader): entries report their size
+    via ``sizeof``; inserts evict least-recently-used entries until the
+    total fits ``cap_bytes``.  An entry larger than the whole cap is
+    served but never stored."""
+
+    def __init__(self, cap_bytes: int, sizeof=None):
+        import sys
+
+        self.cap_bytes = int(cap_bytes)
+        # nbytes for array payloads; getsizeof otherwise, so the cap is
+        # never silently unenforced for non-array values.
+        self._sizeof = sizeof or (
+            lambda v: getattr(v, "nbytes", None) or sys.getsizeof(v))
+        self._data: Dict[Any, Any] = {}
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._lock:
+            if key not in self._data:
+                return default
+            val = self._data.pop(key)
+            self._data[key] = val  # move to most-recent position
+            return val
+
+    def put(self, key, value) -> None:
+        size = self._sizeof(value)
+        with self._lock:
+            if key in self._data:
+                self._bytes -= self._sizeof(self._data.pop(key))
+            if size > self.cap_bytes:
+                return
+            while self._data and self._bytes + size > self.cap_bytes:
+                oldest = next(iter(self._data))  # insertion order = LRU order
+                self._bytes -= self._sizeof(self._data.pop(oldest))
+            self._data[key] = value
+            self._bytes += size
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._bytes = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data

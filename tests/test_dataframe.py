@@ -105,6 +105,31 @@ def test_map_rows_schema_promotion():
     assert out2.table.column("b").type == pa.float64()
 
 
+def test_map_rows_int_to_float_widening():
+    """ADVICE r3 (medium): an int64-inferred first batch must NOT silently
+    truncate a later float batch (from_pylist(schema=...) coerces 3.5 -> 3
+    without raising).  Per-batch inference + unify must yield float64."""
+    import pyarrow as pa
+
+    df = DataFrame(pa.table({"a": [1, 2, 3, 4]}))
+    out = df.map_rows(
+        lambda r: {"b": r["a"] if r["a"] < 3 else r["a"] + 0.5}, batch_size=2)
+    assert out.table.column("b").type == pa.float64()
+    assert [r["b"] for r in out.collect()] == [1.0, 2.0, 3.5, 4.5]
+
+
+def test_map_rows_missing_key_null_fills():
+    """A batch whose rows omit a key some other batch produced null-fills
+    that column (pinned-schema behavior preserved across the unify path)."""
+    import pyarrow as pa
+
+    df = DataFrame(pa.table({"a": [1, 2, 3, 4]}))
+    out = df.map_rows(
+        lambda r: {"b": r["a"]} if r["a"] < 3 else {"b": r["a"], "c": "x"},
+        batch_size=2)
+    assert [r["c"] for r in out.collect()] == [None, None, "x", "x"]
+
+
 def test_map_blocks_columnar():
     """Block-wise map (TensorFrames map_blocks parity): fn sees record
     batches, never per-row Python objects, and may change the layout."""

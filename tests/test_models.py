@@ -177,3 +177,37 @@ def test_efficientnet_imagenet_rescaling_fixup():
     np.testing.assert_allclose(
         np.asarray(variables["batch_stats"]["normalization"]["post_scale"]),
         np.asarray(scale, np.float32), rtol=1e-6)
+
+
+def test_efficientnet_drop_connect():
+    """ADVICE r3: stochastic depth is available for fine-tuning (keras
+    recipe parity) behind a rate knob: default 0 is identity (no rng
+    needed), rate>0 in train mode drops residual branches per sample,
+    and inference is unaffected by the knob."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.efficientnet import EfficientNetB0
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.random((2, 64, 64, 3)) * 255, jnp.float32)
+
+    base = EfficientNetB0(num_classes=5)
+    variables = base.init(jax.random.PRNGKey(0), x, train=False)
+    out0 = base.apply(variables, x, train=False, features=True)
+
+    sd = EfficientNetB0(num_classes=5, drop_connect_rate=0.9)
+    # inference: knob is inert, bit-identical features
+    out_inf = sd.apply(variables, x, train=False, features=True)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(out_inf))
+    # train mode with rate>0 needs a dropout rng and perturbs the output
+    outs = []
+    for seed in (1, 2):
+        o, _ = sd.apply(variables, x, train=True, features=True,
+                        mutable=["batch_stats"],
+                        rngs={"dropout": jax.random.PRNGKey(seed)})
+        outs.append(np.asarray(o))
+    assert not np.allclose(outs[0], outs[1])
+    # rate=0 in train mode stays rng-free (the estimator fine-tune path)
+    base.apply(variables, x, train=True, features=True,
+               mutable=["batch_stats"])
