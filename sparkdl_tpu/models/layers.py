@@ -55,6 +55,13 @@ class SeparableConv2D(nn.Module):
     importer can copy Keras weights verbatim.  Lowered as a grouped conv
     (feature_group_count=Cin) followed by a 1x1 conv — XLA fuses both onto
     the MXU.
+
+    ``fused_flat`` switches to the pallas fused inference path
+    (``ops/sepconv.py``): the input/output are PADDED-FLAT
+    [N, (H+2)*Wp, C] and the BatchNorm affine + activations fuse into the
+    kernel.  Param creation is identical either way, so a module's
+    variables are interchangeable between paths (and with the keras
+    importer).
     """
 
     features: int
@@ -66,7 +73,8 @@ class SeparableConv2D(nn.Module):
     dtype: Any = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 fused_flat: Optional[dict] = None) -> jnp.ndarray:
         cin = x.shape[-1]
         kh, kw = self.kernel_size
         dw = self.param(
@@ -77,6 +85,21 @@ class SeparableConv2D(nn.Module):
             "pointwise_kernel",
             nn.initializers.lecun_normal(),
             (1, 1, cin * self.depth_multiplier, self.features))
+        if fused_flat is not None:
+            assert (self.kernel_size == (3, 3)
+                    and self.strides == (1, 1)
+                    and self.padding == "SAME"
+                    and self.depth_multiplier == 1
+                    and not self.use_bias), \
+                "fused path: 3x3/s1/SAME/mult1/nobias"
+            from sparkdl_tpu.ops.sepconv import fused_sepconv_flat
+
+            return fused_sepconv_flat(
+                x, dw, pw, fused_flat["scale"], fused_flat["shift"],
+                h=fused_flat["h"], w=fused_flat["w"],
+                pre_relu=fused_flat.get("pre_relu", False),
+                post_relu=fused_flat.get("post_relu", False),
+                force=fused_flat.get("force"))
         dtype = self.dtype or x.dtype
         import jax.lax as lax
 
@@ -90,6 +113,35 @@ class SeparableConv2D(nn.Module):
             b = self.param("bias", nn.initializers.zeros, (self.features,))
             y = y + jnp.asarray(b, dtype)
         return y
+
+
+class BNAffine(nn.Module):
+    """Inference-mode twin of ``nn.BatchNorm``: declares the IDENTICAL
+    variable tree (params scale/bias, batch_stats mean/var — same names,
+    shapes, inits) but returns the folded affine ``(scale', shift')`` with
+    scale' = gamma / sqrt(var + eps), shift' = beta - mean * scale',
+    for fusion into a preceding conv's epilogue (ops/sepconv.py).  A model
+    can therefore apply the same variables through either module."""
+
+    epsilon: float = BN_EPS_DEFAULT
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, features: int):
+        mean = self.variable("batch_stats", "mean",
+                             lambda: jnp.zeros((features,), jnp.float32))
+        var = self.variable("batch_stats", "var",
+                            lambda: jnp.ones((features,), jnp.float32))
+        beta = self.param("bias", nn.initializers.zeros, (features,))
+        if self.use_scale:
+            gamma = self.param("scale", nn.initializers.ones, (features,))
+        else:
+            gamma = jnp.float32(1.0)
+        s = (jnp.asarray(gamma, jnp.float32)
+             / jnp.sqrt(jnp.asarray(var.value, jnp.float32) + self.epsilon))
+        t = jnp.asarray(beta, jnp.float32) - \
+            jnp.asarray(mean.value, jnp.float32) * s
+        return s, t
 
 
 class DepthwiseConv2D(nn.Module):

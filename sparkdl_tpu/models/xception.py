@@ -14,10 +14,13 @@ BN epsilon is the Keras default 1e-3.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import linen as nn
 
-from sparkdl_tpu.models.layers import SeparableConv2D, global_avg_pool
+from sparkdl_tpu.models.layers import (BNAffine, SeparableConv2D,
+                                       global_avg_pool)
 
 # (block index, filters) of the three entry-flow residual blocks.
 _ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))
@@ -35,19 +38,60 @@ def xception_auto_order():
 
 
 class Xception(nn.Module):
+    """``fused_inference`` routes every separable conv through the pallas
+    fused kernel (``ops/sepconv.py``) when not training: None = auto (on
+    for single-device TPU backends), True = always (CPU falls back to the
+    jax reference path — used by parity tests), False = never.  Both
+    paths declare identical variables, so weights import/persist the same
+    way regardless."""
+
     num_classes: int = 1000
+    fused_inference: Optional[bool] = None
+
+    def _use_fused(self, train: bool) -> bool:
+        if train:
+            return False
+        if self.fused_inference is not None:
+            return self.fused_inference
+        import jax
+
+        from sparkdl_tpu.ops.sepconv import _on_tpu
+
+        return _on_tpu() and jax.device_count() == 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False,
                  features: bool = False, logits: bool = False) -> jnp.ndarray:
+        fused = self._use_fused(train)
 
         def bn(name):
             return nn.BatchNorm(use_running_average=not train, momentum=0.99,
                                 epsilon=1e-3, name=name)
 
-        def sep(x, filters, name):
+        def sep(x, filters, name, pre_relu=False, post_relu=False,
+                flat_hw=None):
+            """sepconv + BN (+ neighboring ReLUs).  When ``fused`` and a
+            ``flat_hw`` is given, x is PADDED-FLAT [N,(H+2)*Wp,C] and the
+            whole stack runs as one pallas kernel; otherwise the plain
+            NHWC conv/BN modules run (XLA path)."""
+            if fused and flat_hw is not None:
+                s, t = BNAffine(epsilon=1e-3, name=f"{name}_bn")(filters)
+                h, w = flat_hw
+                return SeparableConv2D(filters, (3, 3), use_bias=False,
+                                       name=name)(
+                    x, fused_flat=dict(scale=s, shift=t, h=h, w=w,
+                                       pre_relu=pre_relu,
+                                       post_relu=post_relu))
+            if pre_relu:
+                x = nn.relu(x)
             x = SeparableConv2D(filters, (3, 3), use_bias=False, name=name)(x)
-            return bn(f"{name}_bn")(x)
+            x = bn(f"{name}_bn")(x)
+            if post_relu:
+                x = nn.relu(x)
+            return x
+
+        if fused:
+            from sparkdl_tpu.ops.sepconv import pad_to_flat, unflatten
 
         # Entry flow: two plain convs (VALID, stride-2 first)
         x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
@@ -58,40 +102,75 @@ class Xception(nn.Module):
         x = nn.relu(bn("block1_conv2_bn")(x))
 
         # Entry-flow residual blocks (block2 has no leading relu — upstream
-        # quirk preserved)
+        # quirk preserved).  Fused mode routes block4 (37x37, VMEM-sized)
+        # through the kernel; blocks 2-3 (147/74 spatial) stay on XLA.
         for i, f in _ENTRY_BLOCKS:
             residual = nn.Conv(f, (1, 1), strides=(2, 2), padding="SAME",
                                use_bias=False, name=f"shortcut{i}_conv")(x)
             residual = bn(f"shortcut{i}_bn")(residual)
-            if i > 2:
-                x = nn.relu(x)
-            x = sep(x, f, f"block{i}_sepconv1")
-            x = nn.relu(x)
-            x = sep(x, f, f"block{i}_sepconv2")
+            if fused and i == 4:
+                h, w = x.shape[1], x.shape[2]
+                xf = pad_to_flat(x, h, w)
+                xf = sep(xf, f, f"block{i}_sepconv1", pre_relu=True,
+                         flat_hw=(h, w))
+                xf = sep(xf, f, f"block{i}_sepconv2", pre_relu=True,
+                         flat_hw=(h, w))
+                x = unflatten(xf, h, w)
+            else:
+                x = sep(x, f, f"block{i}_sepconv1", pre_relu=i > 2)
+                x = sep(x, f, f"block{i}_sepconv2", pre_relu=True)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
             x = x + residual
 
-        # Middle flow: 8 identity blocks of three sepconvs
-        for i in range(5, 13):
-            residual = x
-            for j in (1, 2, 3):
-                x = nn.relu(x)
-                x = sep(x, 728, f"block{i}_sepconv{j}")
-            x = x + residual
+        # Middle flow: 8 identity blocks of three sepconvs.  In fused mode
+        # the whole flow CHAINS in padded-flat layout — the kernel's output
+        # halo contract means zero repacking passes between the 24 layers.
+        if fused:
+            h, w = x.shape[1], x.shape[2]
+            xf = pad_to_flat(x, h, w)
+            for i in range(5, 13):
+                res_f = xf
+                for j in (1, 2, 3):
+                    xf = sep(xf, 728, f"block{i}_sepconv{j}", pre_relu=True,
+                             flat_hw=(h, w))
+                xf = xf + res_f
+            x19 = unflatten(xf, h, w)
+        else:
+            for i in range(5, 13):
+                residual = x
+                for j in (1, 2, 3):
+                    x = sep(x, 728, f"block{i}_sepconv{j}", pre_relu=True)
+                x = x + residual
+            x19 = x
 
         # Exit flow
         residual = nn.Conv(1024, (1, 1), strides=(2, 2), padding="SAME",
-                           use_bias=False, name="shortcut13_conv")(x)
+                           use_bias=False, name="shortcut13_conv")(x19)
         residual = bn("shortcut13_bn")(residual)
-        x = nn.relu(x)
-        x = sep(x, 728, "block13_sepconv1")
-        x = nn.relu(x)
-        x = sep(x, 1024, "block13_sepconv2")
+        if fused:
+            h, w = x19.shape[1], x19.shape[2]
+            xf = sep(xf, 728, "block13_sepconv1", pre_relu=True,
+                     flat_hw=(h, w))
+            xf = sep(xf, 1024, "block13_sepconv2", pre_relu=True,
+                     flat_hw=(h, w))
+            x = unflatten(xf, h, w)
+        else:
+            x = sep(x19, 728, "block13_sepconv1", pre_relu=True)
+            x = sep(x, 1024, "block13_sepconv2", pre_relu=True)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         x = x + residual
 
-        x = nn.relu(sep(x, 1536, "block14_sepconv1"))
-        x = nn.relu(sep(x, 2048, "block14_sepconv2"))
+        if fused:
+            h = x.shape[1]
+            xf = pad_to_flat(x, h, x.shape[2])
+            xf = sep(xf, 1536, "block14_sepconv1", post_relu=True,
+                     flat_hw=(h, x.shape[2]))
+            xf = sep(xf, 2048, "block14_sepconv2", post_relu=True,
+                     flat_hw=(h, x.shape[2]))
+            x = unflatten(xf, h, x.shape[2])
+        else:
+            x = sep(x, 1536, "block14_sepconv1", post_relu=True)
+            x = sep(x, 2048, "block14_sepconv2", post_relu=True)
         x = global_avg_pool(x)  # 2048-d featurizer cut
         if features:
             return x

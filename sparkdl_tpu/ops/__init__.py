@@ -1,0 +1,14 @@
+"""TPU kernels (pallas) for hot ops the XLA autofuser leaves on the table.
+
+The zoo's compute path is plain jax/flax wherever XLA already emits
+optimal code (dense convs ride the MXU untouched); this package holds the
+exceptions — ops whose default lowering materializes avoidable HBM
+traffic, rewritten as fused pallas kernels with reference-parity jax
+fallbacks for CPU/debug.
+"""
+
+from sparkdl_tpu.ops.sepconv import (fused_sepconv_flat, pad_to_flat,
+                                     sepconv_reference, unflatten)
+
+__all__ = ["fused_sepconv_flat", "pad_to_flat", "sepconv_reference",
+           "unflatten"]
