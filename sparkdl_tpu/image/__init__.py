@@ -26,6 +26,7 @@ from sparkdl_tpu.image.io import (
     createResizeImageUDF,
     PIL_decode,
     structsToBatch,
+    arrowStructsToBatch,
     iterFileBatches,
     iterImageBatches,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "createResizeImageUDF",
     "PIL_decode",
     "structsToBatch",
+    "arrowStructsToBatch",
     "iterFileBatches",
     "iterImageBatches",
 ]

@@ -20,6 +20,7 @@ from sparkdl_tpu.image.schema import (
     imageArrayToStruct,
     imageSchema,
     imageStructToArray,
+    imageTypeByMode,
 )
 
 
@@ -228,6 +229,144 @@ def structsToBatch(structs: Sequence[dict], height: int, width: int,
         arrs = list(_io_executor().map(
             lambda s: structToModelInput(s, height, width), structs))
     return np.stack(arrs, axis=0)
+
+
+def arrowStructsToBatch(column, height: int, width: int,
+                        channel_order: str = "rgb", compact: bool = False
+                        ) -> "tuple[np.ndarray, np.ndarray]":
+    """Image-struct Arrow column -> ([N,h,w,3] uint8 batch, valid mask)
+    WITHOUT materializing per-row Python dicts.
+
+    This is the zero-copy replacement for ``to_pylist()`` +
+    :func:`structsToBatch` on the UDF/scoring hot path: child arrays are
+    read as numpy views over Arrow buffers, and each row's pixel block is
+    sliced straight out of the binary child's value buffer.  When every
+    valid row is already ``height x width`` uint8 BGR (the common case for a
+    resized column), packing is one ~memcpy per row.  Chunked columns are
+    packed chunk by chunk (never ``combine_chunks``, whose int32 binary
+    offsets overflow past 2 GB of image bytes).
+
+    ``channel_order``: "rgb" (default) swaps BGR struct bytes to RGB on the
+    host; "bgr" returns the struct's native byte order untouched — the fast
+    feed for pipelines that fold the channel swap into the device program
+    (as the reference's converter subgraph did: ``graph/pieces.py``
+    buildSpImageConverter swapped BGR->RGB *inside* the graph).  Host cost
+    measured at 299x299: ~0.01 ms/img for "bgr", ~0.25 ms/img for "rgb"
+    (the swap is the only non-memcpy work).
+
+    ``compact``: when True the batch holds ONLY the ok rows (in row order) —
+    row ``k`` of the batch is the ``k``-th True of the mask — so callers
+    feeding an engine skip both the null-row zero fill and a second
+    valid-rows copy.  When False (default) the batch is row-aligned with
+    the column and failed rows are zeroed, matching the reference's
+    scoring-path null contract.
+    """
+    if channel_order not in ("rgb", "bgr"):
+        raise ValueError(f"channel_order must be 'rgb' or 'bgr', "
+                         f"got {channel_order!r}")
+    if isinstance(column, pa.ChunkedArray):
+        chunks = column.chunks
+        if len(chunks) == 1:
+            column = chunks[0]
+        else:
+            parts = [arrowStructsToBatch(c, height, width,
+                                         channel_order=channel_order,
+                                         compact=compact)
+                     for c in chunks if len(c)]
+            if not parts:
+                return (np.zeros((0, height, width, 3), dtype=np.uint8),
+                        np.zeros(0, dtype=bool))
+            return (np.concatenate([p[0] for p in parts], axis=0),
+                    np.concatenate([p[1] for p in parts], axis=0))
+    n = len(column)
+    ok = np.zeros(n, dtype=bool)
+    if n == 0:
+        return np.zeros((0, height, width, 3), dtype=np.uint8), ok
+    valid = np.asarray(column.is_valid())
+    idx = np.nonzero(valid)[0]
+    nrows = len(idx) if compact else n
+    if len(idx) == 0:
+        return np.zeros((nrows, height, width, 3), dtype=np.uint8), ok
+    # Child arrays: pyarrow's .field() applies the parent struct's
+    # offset/length, so sliced columns are handled.
+    heights = np.asarray(column.field("height"))
+    widths = np.asarray(column.field("width"))
+    channels = np.asarray(column.field("nChannels"))
+    modes = np.asarray(column.field("mode"))
+    data = column.field("data")
+    # Binary child buffers: [validity, int32 offsets, values].  The child
+    # carries its own offset when the parent was sliced.
+    bufs = data.buffers()
+    offsets = np.frombuffer(bufs[1], dtype=np.int32)[
+        data.offset:data.offset + n + 1]
+    values = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+        else np.zeros(0, dtype=np.uint8)
+
+    # slot[k]: output row for source row idx[k]
+    slots = np.arange(len(idx)) if compact else idx
+    uniform = (
+        np.all(heights[idx] == height) and np.all(widths[idx] == width)
+        and np.all(channels[idx] == 3) and np.all(modes[idx] == 16)  # CV_8UC3
+        and np.all((offsets[idx + 1] - offsets[idx]) == height * width * 3))
+    if uniform:
+        hw3 = height * width * 3
+        # compact output is fully written -> skip the zero fill
+        alloc = np.empty if compact else np.zeros
+        if channel_order == "bgr":
+            out = alloc((nrows, height, width, 3), dtype=np.uint8)
+            for s, i in zip(slots, idx):  # pure memcpy per row
+                out[s] = values[offsets[i]:offsets[i] + hw3].reshape(
+                    height, width, 3)
+        else:
+            # memcpy rows, then one batch-level channel shuffle (3 strided
+            # assigns beat a negative-stride copy ~3x on this host)
+            tmp = alloc((nrows, height, width, 3), dtype=np.uint8)
+            if not compact:
+                tmp[~valid] = 0  # null rows must stay zeroed post-shuffle
+            for s, i in zip(slots, idx):
+                tmp[s] = values[offsets[i]:offsets[i] + hw3].reshape(
+                    height, width, 3)
+            out = np.empty_like(tmp)
+            out[..., 0] = tmp[..., 2]
+            out[..., 1] = tmp[..., 1]
+            out[..., 2] = tmp[..., 0]
+        ok[idx] = True
+        return out, ok
+
+    # General path: per-row buffer views (still no dict round trip), then
+    # the normal channel normalization + resize, threaded for large rows.
+    out = np.zeros((nrows, height, width, 3), dtype=np.uint8)
+
+    def one(si):
+        s, i = si
+        t = imageTypeByMode(int(modes[i]))
+        h, w, c = int(heights[i]), int(widths[i]), int(channels[i])
+        row = values[offsets[i]:offsets[i + 1]]
+        arr = row.view(t.dtype) if t.dtype != "uint8" else row
+        if arr.size != h * w * c:
+            return
+        arr = arr.reshape(h, w, c)
+        if arr.dtype != np.uint8:
+            arr = np.clip(arr, 0, 255).astype(np.uint8)
+        if c == 1:
+            arr = np.repeat(arr, 3, axis=2)
+        elif c == 4:
+            arr = arr[:, :, :3]
+        resized = resizeImage(np.ascontiguousarray(arr), height, width)
+        out[s] = resized if channel_order == "bgr" else resized[:, :, ::-1]
+        ok[i] = True
+
+    pairs = list(zip(slots, idx))
+    if len(pairs) >= 4:
+        list(_io_executor().map(one, pairs))
+    else:
+        for p in pairs:
+            one(p)
+    if compact and not ok[idx].all():
+        # a valid struct failed decode (size mismatch): drop its slot so
+        # batch rows stay aligned with the True positions of the mask
+        out = out[ok[idx]]
+    return out, ok
 
 
 def _list_files(path: str, recursive: bool = False) -> List[str]:

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from sparkdl_tpu.image.io import structsToBatch
+from sparkdl_tpu.image.io import arrowStructsToBatch
 from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
 from sparkdl_tpu.models import get_model_spec, load_model
 from sparkdl_tpu.models.imagenet import decode_predictions
@@ -110,16 +110,20 @@ class _ImageInputStage(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
         col_idx = dataset.table.column_names.index(name)
         offset = 0
         for rb in dataset.iter_batches(chunk_rows):
-            structs = rb.column(col_idx).to_pylist()
-            vi_local = [i for i, s in enumerate(structs) if s is not None]
-            if vi_local:
-                valid_idx.extend(offset + i for i in vi_local)
+            col = rb.column(col_idx)
+            # zero-copy struct packing (no per-row dict materialization);
+            # compact=True: the batch holds only the decodable rows
+            batch, ok = arrowStructsToBatch(col, height, width,
+                                            compact=True)
+            vi_local = np.nonzero(ok)[0]
+            if len(vi_local):
+                valid_idx.extend(int(offset + i) for i in vi_local)
                 if origins is not None:
+                    ocol = col.field("origin")
                     origins.extend(
-                        structs[i].get("origin", "") or "" for i in vi_local)
-                yield structsToBatch(
-                    [structs[i] for i in vi_local], height, width)
-            offset += len(structs)
+                        (ocol[int(i)].as_py() or "") for i in vi_local)
+                yield batch
+            offset += len(col)
 
     def _chunk_rows(self) -> int:
         """Decode granularity: batchSize rounded up to the data-axis size,

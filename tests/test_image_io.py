@@ -103,3 +103,171 @@ def test_resize_udf_on_struct(rng):
     assert udf(None) is None
     with pytest.raises(ValueError):
         createResizeImageUDF([1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# arrowStructsToBatch: the zero-copy UDF hot path (VERDICT r3 #5)
+
+def _struct_column(arrays, origins=None, nulls=()):
+    """Build an image-struct arrow column from [H,W,C] BGR arrays, with
+    ``None`` at the positions listed in ``nulls``."""
+    import pyarrow as pa
+    from sparkdl_tpu.image import imageSchema
+    structs = []
+    j = 0
+    n = len(arrays) + len(nulls)
+    for i in range(n):
+        if i in nulls:
+            structs.append(None)
+        else:
+            structs.append(imageArrayToStruct(
+                arrays[j], origin="" if origins is None else origins[j]))
+            j += 1
+    return pa.array(structs, type=imageSchema)
+
+
+def test_arrow_structs_uniform_parity(rng):
+    """Fast path (all rows target-size uint8 BGR) matches structsToBatch."""
+    from sparkdl_tpu.image import arrowStructsToBatch, structsToBatch
+    arrays = [(rng.random((16, 16, 3)) * 255).astype(np.uint8)
+              for _ in range(6)]
+    col = _struct_column(arrays)
+    batch, ok = arrowStructsToBatch(col, 16, 16)
+    assert ok.all() and batch.shape == (6, 16, 16, 3)
+    ref = structsToBatch(col.to_pylist(), 16, 16)
+    np.testing.assert_array_equal(batch, ref)
+
+
+def test_arrow_structs_nulls_and_slice(rng):
+    """Null rows -> ok=False + zeros; sliced columns read correct buffers."""
+    from sparkdl_tpu.image import arrowStructsToBatch
+    arrays = [np.full((8, 8, 3), 10 * (i + 1), np.uint8) for i in range(4)]
+    col = _struct_column(arrays, nulls=(2,))  # [10, 20, None, 30, 40]
+    batch, ok = arrowStructsToBatch(col, 8, 8)
+    assert list(ok) == [True, True, False, True, True]
+    assert (batch[2] == 0).all()
+    assert (batch[3] == 30).all()  # array index shifts past the null
+    # slice: drop the first two rows — offsets must follow the slice
+    sliced = col.slice(2, 3)
+    b2, ok2 = arrowStructsToBatch(sliced, 8, 8)
+    assert list(ok2) == [False, True, True]
+    assert (b2[1] == 30).all() and (b2[2] == 40).all()
+
+
+def test_arrow_structs_resize_and_modes(rng):
+    """Mixed sizes / grayscale / float32 rows take the general path and
+    match the per-dict converter bit-for-bit."""
+    import pyarrow as pa
+    from sparkdl_tpu.image import arrowStructsToBatch, imageSchema
+    from sparkdl_tpu.image.io import structToModelInput
+    arrays = [
+        (rng.random((20, 30, 3)) * 255).astype(np.uint8),   # resize needed
+        (rng.random((12, 12, 1)) * 255).astype(np.uint8),   # grayscale
+        (rng.random((12, 12, 3)) * 255).astype(np.float32),  # CV_32FC3
+        (rng.random((12, 12, 4)) * 255).astype(np.uint8),   # BGRA
+    ]
+    structs = [imageArrayToStruct(a) for a in arrays]
+    col = pa.array(structs, type=imageSchema)
+    batch, ok = arrowStructsToBatch(col, 12, 12)
+    assert ok.all()
+    for i, s in enumerate(structs):
+        np.testing.assert_array_equal(batch[i], structToModelInput(s, 12, 12))
+
+
+def test_arrow_structs_chunked_and_empty(rng):
+    import pyarrow as pa
+    from sparkdl_tpu.image import arrowStructsToBatch, imageSchema
+    arrays = [np.full((4, 4, 3), i + 1, np.uint8) for i in range(4)]
+    c1 = _struct_column(arrays[:2])
+    c2 = _struct_column(arrays[2:])
+    chunked = pa.chunked_array([c1, c2])
+    batch, ok = arrowStructsToBatch(chunked, 4, 4)
+    assert ok.all() and (batch[3] == 4).all()
+    empty = pa.array([], type=imageSchema)
+    b0, ok0 = arrowStructsToBatch(empty, 4, 4)
+    assert b0.shape == (0, 4, 4, 3) and ok0.shape == (0,)
+    allnull = pa.array([None, None], type=imageSchema)
+    bn, okn = arrowStructsToBatch(allnull, 4, 4)
+    assert not okn.any() and (bn == 0).all()
+
+
+def test_arrow_structs_channel_order(rng):
+    """channel_order='bgr' returns struct bytes untouched (the UDF hot-path
+    feed; the device program does the swap); 'rgb' is its flip."""
+    from sparkdl_tpu.image import arrowStructsToBatch
+    arrays = [(rng.random((10, 10, 3)) * 255).astype(np.uint8)
+              for _ in range(3)]
+    col = _struct_column(arrays)
+    bgr, ok = arrowStructsToBatch(col, 10, 10, channel_order="bgr")
+    rgb, _ = arrowStructsToBatch(col, 10, 10)
+    assert ok.all()
+    np.testing.assert_array_equal(bgr, np.stack(arrays))
+    np.testing.assert_array_equal(rgb, bgr[..., ::-1])
+    # general (resize) path honors the order too
+    big = [(rng.random((20, 20, 3)) * 255).astype(np.uint8)]
+    colb = _struct_column(big)
+    b, _ = arrowStructsToBatch(colb, 10, 10, channel_order="bgr")
+    r, _ = arrowStructsToBatch(colb, 10, 10)
+    np.testing.assert_array_equal(r, b[..., ::-1])
+    with pytest.raises(ValueError):
+        arrowStructsToBatch(col, 10, 10, channel_order="hsv")
+
+
+def test_arrow_structs_packing_cost(rng):
+    """Host packing cost per 299x299 image stays under 0.5 ms (VERDICT r3
+    #5 target) on the UDF hot path (BGR passthrough: pure memcpy — the
+    channel swap rides the fused device program)."""
+    import time
+    from sparkdl_tpu.image import arrowStructsToBatch
+    n = 32
+    arrays = [(rng.random((299, 299, 3)) * 255).astype(np.uint8)
+              for _ in range(n)]
+    col = _struct_column(arrays)
+    arrowStructsToBatch(col, 299, 299, channel_order="bgr")  # warm
+    best = float("inf")
+    for _ in range(3):  # best-of-3: 1-vCPU CI hosts are noisy
+        t0 = time.perf_counter()
+        batch, ok = arrowStructsToBatch(col, 299, 299, channel_order="bgr")
+        best = min(best, (time.perf_counter() - t0) * 1000 / n)
+    assert ok.all()
+    assert best < 0.5, f"packing cost {best:.3f} ms/img"
+
+
+def test_arrow_structs_compact(rng):
+    """compact=True emits only ok rows, in row order, on every path —
+    uniform, resize, chunked — and never zero-fills null slots."""
+    import pyarrow as pa
+    from sparkdl_tpu.image import arrowStructsToBatch, imageSchema
+    arrays = [np.full((8, 8, 3), 10 * (i + 1), np.uint8) for i in range(4)]
+    col = _struct_column(arrays, nulls=(1, 3))  # [10, None, 20, None, 30, 40]
+    b, ok = arrowStructsToBatch(col, 8, 8, compact=True)
+    assert b.shape[0] == 4 and list(ok) == [True, False, True, False,
+                                            True, True]
+    assert [int(b[k, 0, 0, 2]) for k in range(4)] == [10, 20, 30, 40]
+    # resize (general) path
+    big = [np.full((16, 16, 3), 7, np.uint8), np.full((16, 16, 3), 9,
+                                                      np.uint8)]
+    colb = _struct_column(big, nulls=(1,))
+    bb, okb = arrowStructsToBatch(colb, 8, 8, compact=True)
+    assert bb.shape[0] == 2 and list(okb) == [True, False, True]
+    assert (bb[0] == 7).all() and (bb[1] == 9).all()
+    # multi-chunk: packed per chunk (no combine_chunks), concatenated
+    chunked = pa.chunked_array([_struct_column(arrays[:2], nulls=(1,)),
+                                _struct_column(arrays[2:])])
+    bc, okc = arrowStructsToBatch(chunked, 8, 8, compact=True)
+    assert bc.shape[0] == 4 and okc.sum() == 4
+    assert [int(bc[k, 0, 0, 2]) for k in range(4)] == [10, 20, 30, 40]
+
+
+def test_arrow_structs_multi_chunk_never_combines():
+    """Chunked columns must be packed chunk by chunk: combine_chunks on a
+    binary child overflows int32 offsets past 2 GB of image bytes
+    (ArrowInvalid on pyarrow 25).  pa.ChunkedArray is an immutable C type
+    (cannot be spied on), so pin the invariant at the source level for the
+    two functions on the image hot path."""
+    import inspect
+
+    import sparkdl_tpu.udf.registry as registry_mod
+    from sparkdl_tpu.image.io import arrowStructsToBatch
+    assert ".combine_chunks(" not in inspect.getsource(arrowStructsToBatch)
+    assert ".combine_chunks(" not in inspect.getsource(registry_mod)

@@ -290,21 +290,21 @@ def test_image_mode_packs_outputs_incrementally(fixture_images, monkeypatch):
     from sparkdl_tpu.frame import DataFrame
 
     events = []
-    real_s2b = ni.structsToBatch
+    real_s2b = ni.arrowStructsToBatch
     real_a2s = ni.imageArrayToStruct
 
-    def spy_decode(structs, h, w, **kw):
+    def spy_decode(column, h, w, **kw):
         # slow the producer so interleaving is deterministic: the consumer
         # packs chunk 1 long before the serial decode of chunk 6 starts
         time.sleep(0.05)
         events.append("decode")
-        return real_s2b(structs, h, w, **kw)
+        return real_s2b(column, h, w, **kw)
 
     def spy_pack(arr, origin=""):
         events.append("pack")
         return real_a2s(arr, origin=origin)
 
-    monkeypatch.setattr(ni, "structsToBatch", spy_decode)
+    monkeypatch.setattr(ni, "arrowStructsToBatch", spy_decode)
     monkeypatch.setattr(ni, "imageArrayToStruct", spy_pack)
 
     def fail_run_streaming(*a, **kw):

@@ -138,3 +138,26 @@ def test_pandas_udf_contract_with_stub_pyspark(monkeypatch):
     out = spark_udf(series)
     assert isinstance(out, pd.Series)
     assert list(out) == [[2.0, 4.0], [6.0, 8.0]]
+
+
+def test_arrow_hot_path_parity_with_list_path(image_df):
+    """The zero-copy Arrow scoring path (apply over a DataFrame) and the
+    legacy list-of-dicts path produce identical scores, and the Arrow
+    column is handed to the UDF without to_pylist (VERDICT r3 #5)."""
+    reg = UDFRegistry()
+    mf = ModelFunction(
+        fn=lambda v, x: x.reshape(x.shape[0], -1) @ v["w"],
+        variables={"w": np.arange(16 * 16 * 3 * 2, dtype=np.float32
+                                  ).reshape(16 * 16 * 3, 2) / 1e4})
+    udf = register_image_udf("parity_udf", mf, input_size=(16, 16),
+                             registry=reg)
+    assert getattr(udf.fn, "accepts_arrow", False)
+    col = image_df.table.column("image")
+    arrow_out = udf(col)                      # arrow path
+    list_out = udf.fn(col.to_pylist())        # legacy path
+    assert len(arrow_out) == len(list_out)
+    for a, b in zip(arrow_out, list_out):
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
