@@ -122,13 +122,12 @@ def structToModelInput(struct: dict, height: int, width: int) -> np.ndarray:
 
 
 def _native_io_preferred() -> bool:
-    """Use the native core when it can actually win: it scales with real
-    threads (no GIL), so it needs >1 core; on a single-core host PIL's
-    SIMD decode is faster serially."""
+    """Use the native core whenever it built: measured on a 1-vCPU host
+    (tools/native_thread_scaling.py, PERF.md) it beats serial PIL even
+    single-threaded (232 vs 192 img/s at 500x375 JPEG -> 299x299), and it
+    scales with real threads (no GIL) on multi-core hosts."""
     import sparkdl_tpu.native as native
 
-    if (os.cpu_count() or 1) <= 1:
-        return False
     return native.native_available()
 
 
@@ -320,9 +319,9 @@ def arrowStructsToBatch(column, height: int, width: int,
         else:
             # memcpy rows, then one batch-level channel shuffle (3 strided
             # assigns beat a negative-stride copy ~3x on this host)
+            # non-compact alloc is zeros, so null rows stay zeroed through
+            # the shuffle; compact output has no null slots to zero
             tmp = alloc((nrows, height, width, 3), dtype=np.uint8)
-            if not compact:
-                tmp[~valid] = 0  # null rows must stay zeroed post-shuffle
             for s, i in zip(slots, idx):
                 tmp[s] = values[offsets[i]:offsets[i] + hw3].reshape(
                     height, width, 3)

@@ -188,8 +188,17 @@ def _populate():
         name="Xception", module_builder=Xception, input_size=(299, 299),
         feature_size=2048, preprocess_mode="tf", keras_app="Xception"),
         xception_auto_order)
+    def _inception_builder():
+        # SPARKDL_S2D_STEM=1 computes stem_conv1 via space-to-depth
+        # (identical variables/math, better MXU occupancy — inception.py)
+        import os
+
+        flag = os.environ.get("SPARKDL_S2D_STEM", "0").lower()
+        return InceptionV3(s2d_stem=flag not in ("0", "", "false"))
+
     _registry.register(ModelSpec(
-        name="InceptionV3", module_builder=InceptionV3, input_size=(299, 299),
+        name="InceptionV3", module_builder=_inception_builder,
+        input_size=(299, 299),
         feature_size=2048, preprocess_mode="tf", keras_app="InceptionV3"),
         inception_import_order)
     # Beyond the reference's five: edge/efficiency-class backbones (see

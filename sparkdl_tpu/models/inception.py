@@ -156,7 +156,15 @@ def inception_import_order():
 
 
 class InceptionV3(nn.Module):
+    """``s2d_stem``: compute ``stem_conv1`` (3x3/s2/VALID on the 3-channel
+    input — 3/128 MXU lane occupancy) via the space-to-depth transform
+    (``layers.SpaceToDepthConv``): same variables, same math (allclose
+    parity pinned in tests/test_models.py), different XLA program.  Off by
+    default; the registry builder enables it when ``SPARKDL_S2D_STEM=1``.
+    Measured delta on the bench is recorded in PERF.md."""
+
     num_classes: int = 1000
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False,
@@ -176,7 +184,10 @@ class InceptionV3(nn.Module):
                 if isinstance(op, C):
                     x = ConvBN(op.filters, (op.kh, op.kw), strides=op.strides,
                                padding=op.padding, bn_eps=1e-3,
-                               bn_scale=False, name=op.name)(x, train=train)
+                               bn_scale=False,
+                               s2d=(self.s2d_stem
+                                    and op.name == "stem_conv1"),
+                               name=op.name)(x, train=train)
                 elif isinstance(op, P):
                     x = pool(x, op)
                 else:  # split: apply both arms to x, concat results

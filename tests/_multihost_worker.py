@@ -19,6 +19,81 @@ import sys
 import numpy as np
 
 
+def tp_fit_reference(epochs: int = 3):
+    """Deterministic (data, params, batch order) for the dp x tp fit —
+    shared by the workers and the in-test single-process oracle."""
+    rng = np.random.default_rng(42)
+    dim, classes, n = 6, 4, 32
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (np.arange(n) % classes).astype(np.int32)
+    params0 = {
+        "body": rng.normal(0, 0.1, (dim, dim)).astype(np.float32),
+        "head": {"kernel": rng.normal(0, 0.1, (dim, classes)
+                                      ).astype(np.float32),
+                 "bias": np.zeros((classes,), np.float32)},
+    }
+    return x, y, params0, epochs
+
+
+def _run_tensor_parallel(pid, nproc, out_path):
+    """dp2 x tp2 over 2 processes x 2 devices (VERDICT r3 #9): the head
+    kernel/bias shard on the ``model`` axis while the batch shards on
+    ``data`` ACROSS processes — every step's activation/gradient
+    collectives cross the process boundary for real."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from sparkdl_tpu.parallel import mesh as mesh_lib
+    from sparkdl_tpu.parallel.train import make_train_step
+
+    mesh = mesh_lib.get_mesh(model_parallel=2)  # (data=2, model=2) on 4 dev
+    x, y, params0, epochs = tp_fit_reference()
+    batch = 8
+    local = batch // nproc
+
+    def predict(p, xb):
+        h = jnp.tanh(jnp.asarray(xb) @ p["body"])
+        return h @ p["head"]["kernel"] + p["head"]["bias"]
+
+    def ce(logits, yb):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb.astype(jnp.int32))
+
+    def tp_rule(path, leaf):
+        if path.endswith("head/kernel"):
+            return P(None, "model")
+        if path.endswith("head/bias"):
+            return P("model")
+        return P()
+
+    opt = optax.sgd(0.1)
+    step = make_train_step(predict, ce, opt, mesh=mesh, cache=False,
+                           param_specs=tp_rule, params_template=params0)
+    params, opt_state = step.put_state(params0, opt.init(params0))
+    losses = []
+    for _ in range(epochs):
+        for off in range(0, len(x), batch):
+            rows = slice(off + pid * local, off + (pid + 1) * local)
+            bx, by = step.put_batch(x[rows], y[rows])
+            params, opt_state, lval = step(params, opt_state, bx, by)
+        losses.append(float(lval))
+    # gather TP-sharded params to replicated so every host can read them
+    gather = jax.jit(lambda p: p, out_shardings=step.replicated)
+    full = jax.tree_util.tree_map(np.asarray, gather(params))
+    with open(out_path, "w") as f:
+        json.dump({
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "mesh_shape": {k: int(v) for k, v in mesh.shape.items()},
+            "losses": losses,
+            "head_kernel": full["head"]["kernel"].ravel().tolist(),
+            "body": full["body"].ravel().tolist(),
+        }, f)
+
+
 def main():
     pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
                                   sys.argv[3], sys.argv[4])
@@ -48,6 +123,9 @@ def main():
     def predict(p, xb):
         return jnp.asarray(xb) @ p["w"]
 
+    if mode == "tp":
+        _run_tensor_parallel(pid, nproc, out_path)
+        return
     if mode not in ("arrays", "stream"):
         raise ValueError(f"unknown worker mode {mode!r}")
     params = {"w": np.zeros((5, 1), np.float32)}

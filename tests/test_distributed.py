@@ -182,3 +182,64 @@ def test_two_process_streaming_fit(tmp_path):
     np.testing.assert_allclose(results[0]["w"], results[1]["w"],
                                rtol=1e-6, atol=1e-7)
     assert all(np.isfinite(r["losses"]).all() for r in results)
+
+
+@pytest.mark.slow
+def test_two_process_tensor_parallel_fit(tmp_path):
+    """REAL 2-process dp2 x tp2 fit (VERDICT r3 #9): the model axis spans
+    devices while the data axis spans PROCESSES, so every step's
+    activation/gradient collectives cross the process boundary.  Both
+    hosts must hold identical gathered params, and the fit must match a
+    single-process dp2 x tp2 oracle on the same data/batch order."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from sparkdl_tpu.parallel.train import make_train_step
+    from tests._multihost_worker import tp_fit_reference
+
+    results = _run_two_process_workers(tmp_path, mode="tp")
+    assert all(r["mesh_shape"] == {"data": 2, "model": 2} for r in results)
+    assert all(len(r["losses"]) == 3 for r in results)
+    np.testing.assert_allclose(results[0]["head_kernel"],
+                               results[1]["head_kernel"],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(results[0]["body"], results[1]["body"],
+                               rtol=1e-6, atol=1e-7)
+
+    # single-process oracle: same dp2 x tp2 topology on 4 local devices
+    x, y, params0, epochs = tp_fit_reference()
+    mesh = get_mesh(num_devices=4, model_parallel=2)
+
+    def predict(p, xb):
+        h = jnp.tanh(jnp.asarray(xb) @ p["body"])
+        return h @ p["head"]["kernel"] + p["head"]["bias"]
+
+    def ce(logits, yb):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb.astype(jnp.int32))
+
+    def tp_rule(path, leaf):
+        if path.endswith("head/kernel"):
+            return P(None, "model")
+        if path.endswith("head/bias"):
+            return P("model")
+        return P()
+
+    opt = optax.sgd(0.1)
+    step = make_train_step(predict, ce, opt, mesh=mesh, cache=False,
+                           param_specs=tp_rule, params_template=params0)
+    params, opt_state = step.put_state(params0, opt.init(params0))
+    for _ in range(epochs):
+        for off in range(0, len(x), 8):
+            bx, by = step.put_batch(x[off:off + 8], y[off:off + 8])
+            params, opt_state, lval = step(params, opt_state, bx, by)
+    gather = jax.jit(lambda p: p, out_shardings=step.replicated)
+    oracle = jax.tree_util.tree_map(np.asarray, gather(params))
+    np.testing.assert_allclose(
+        np.asarray(results[0]["head_kernel"]),
+        oracle["head"]["kernel"].ravel(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(results[0]["body"]),
+        oracle["body"].ravel(), rtol=1e-4, atol=1e-6)

@@ -743,3 +743,81 @@ def test_cross_validator_parallelism_matches_sequential(fixture_images):
     b = m_par.bestModel.transform(df).collect()
     for ra, rb in zip(a, b):
         np.testing.assert_allclose(ra["prediction"], rb["prediction"])
+
+
+def test_fit_multiple_parallel_with_train_batch_stats(uri_label_df):
+    """VERDICT r3 #7: parallelism>1 composed with trainBatchStats=True —
+    concurrent threads driving the stats-mutating train step on different
+    sub-meshes must produce the SAME params AND batch_stats as the
+    sequential whole-mesh fits (global-batch BN stats are psum-exact
+    regardless of slice width)."""
+    def build(par):
+        return ImageFileEstimator(
+            inputCol="uri", outputCol="preds", labelCol="label",
+            modelFunction=_bn_model_function(seed=0),
+            imageLoader=_loader, optimizer="sgd",
+            loss="categorical_crossentropy",
+            fitParams={"epochs": 1, "shuffle": False}, batchSize=8,
+            trainBatchStats=True, parallelism=par)
+
+    def maps_for(est):
+        return [{est.fitParams: {"epochs": 1, "shuffle": False}},
+                {est.fitParams: {"epochs": 3, "shuffle": False}}]
+
+    est_seq = build(1)
+    seq = est_seq.fit(uri_label_df, maps_for(est_seq))
+    est_par = build(2)
+    par = est_par.fit(uri_label_df, maps_for(est_par))
+    assert len(par) == 2
+    for m_seq, m_par in zip(seq, par):
+        assert m_seq.trainLosses == pytest.approx(m_par.trainLosses,
+                                                  rel=1e-4)
+        vs, vp = (m.getModelFunction().variables for m in (m_seq, m_par))
+        np.testing.assert_allclose(
+            np.asarray(vs["batch_stats"]["bn"]["mean"]),
+            np.asarray(vp["batch_stats"]["bn"]["mean"]),
+            rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(vs["params"]["head"]["kernel"]),
+            np.asarray(vp["params"]["head"]["kernel"]),
+            rtol=1e-4, atol=1e-6)
+
+
+def test_fit_multiple_parallel_checkpoint_dirs(tmp_path, uri_label_df):
+    """VERDICT r3 #7: parallelism>1 composed with a shared checkpoint_dir —
+    concurrent maps must write disjoint per-map subdirectories (no
+    cross-map corruption) and still match the sequential fit."""
+    import os
+
+    ck_par = str(tmp_path / "ck_par")
+    ck_seq = str(tmp_path / "ck_seq")
+
+    def build(par, ck):
+        return ImageFileEstimator(
+            inputCol="uri", outputCol="preds", labelCol="label",
+            modelFunction=_tiny_trainable_mf(),
+            imageLoader=_loader, optimizer="sgd",
+            loss="categorical_crossentropy",
+            fitParams={"epochs": 1, "checkpoint_dir": ck,
+                       "shuffle": False}, batchSize=8, parallelism=par)
+
+    def maps_for(est, ck):
+        return [{est.fitParams: {"epochs": 1, "checkpoint_dir": ck,
+                                 "shuffle": False}},
+                {est.fitParams: {"epochs": 2, "checkpoint_dir": ck,
+                                 "shuffle": False}}]
+
+    est_par = build(2, ck_par)
+    par = est_par.fit(uri_label_df, maps_for(est_par, ck_par))
+    est_seq = build(1, ck_seq)
+    seq = est_seq.fit(uri_label_df, maps_for(est_seq, ck_seq))
+    # per-map dirs exist with each map's own epoch count
+    assert sorted(os.listdir(ck_par)) == ["map_000", "map_001"]
+    assert os.path.isdir(os.path.join(ck_par, "map_000", "epoch_000001"))
+    assert os.path.isdir(os.path.join(ck_par, "map_001", "epoch_000002"))
+    for m_seq, m_par in zip(seq, par):
+        assert len(m_seq.trainLosses) == len(m_par.trainLosses)
+        np.testing.assert_allclose(
+            np.asarray(m_seq.getModelFunction().variables["w"]),
+            np.asarray(m_par.getModelFunction().variables["w"]),
+            rtol=1e-4, atol=1e-6)

@@ -1,0 +1,77 @@
+"""Native decode-core thread-scaling measurement (VERDICT r3 #6).
+
+Prints one JSON line per thread count: fused JPEG decode+resize throughput
+(500x375 JPEG -> 299x299 RGB, the flowers-like shape PERF.md uses) through
+``native.decode_resize_batch(num_threads=...)``, plus the serial PIL
+reference.  Run anywhere; the committed PERF.md table carries the numbers
+from this sandbox (1 vCPU) and the CI step re-runs it on the 2-vCPU
+runner so scaling across ≥2 distinct core counts is on record.
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def corpus(n=64, height=375, width=500):
+    from PIL import Image
+
+    rng = np.random.default_rng(7)
+    base = (rng.random((height, width, 3)) * 255).astype(np.uint8)
+    blobs = []
+    for i in range(n):
+        arr = base.copy()
+        arr[:8, :8, 0] = i % 251
+        buf = io.BytesIO()
+        Image.fromarray(arr, "RGB").save(buf, format="JPEG", quality=90)
+        blobs.append(buf.getvalue())
+    return blobs
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import sparkdl_tpu.native as native
+    from sparkdl_tpu.image.io import PIL_decode, resizeImage
+
+    blobs = corpus()
+    n = len(blobs)
+    print(json.dumps({"host_cpus": os.cpu_count()}), flush=True)
+
+    # serial PIL reference (what the fallback path does per core)
+    def pil_once():
+        for b in blobs:
+            arr = PIL_decode(b)
+            resizeImage(arr, 299, 299)
+
+    pil_once()  # warm
+    t0 = time.perf_counter()
+    pil_once()
+    pil_ips = n / (time.perf_counter() - t0)
+    print(json.dumps({"backend": "pil", "threads": 1,
+                      "img_per_s": round(pil_ips, 1)}), flush=True)
+
+    if not native.native_available():
+        print(json.dumps({"backend": "native", "error": "unavailable"}))
+        return
+    for threads in (1, 2, 4, 8):
+        native.decode_resize_batch(blobs, 299, 299,
+                                   num_threads=threads)  # warm
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out, ok = native.decode_resize_batch(blobs, 299, 299,
+                                                 num_threads=threads)
+            dt = time.perf_counter() - t0
+            best = max(best, n / dt)
+        assert ok.all()
+        print(json.dumps({"backend": "native", "threads": threads,
+                          "img_per_s": round(best, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
