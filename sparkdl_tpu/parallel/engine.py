@@ -73,6 +73,7 @@ class InferenceEngine:
                  mesh=None,
                  device_batch_size: int = 64,
                  compute_dtype: Optional[Any] = None,
+                 output_host_dtype: Optional[Any] = None,
                  donate_batch: bool = False,
                  metrics: Optional[Metrics] = None):
         import jax
@@ -89,6 +90,14 @@ class InferenceEngine:
                         "%d-way data axis)", b, self.data_parallel)
         self.device_batch_size = b
         self.metrics = metrics if metrics is not None else Metrics()
+        # Fetch device outputs in their compute dtype and cast on the HOST:
+        # a bf16 model result upcast to f32 on device carries no extra
+        # information, but doubles the D2H bytes of every gather — casting
+        # host-side after the fetch is bit-identical and halves transfer
+        # (minimise host<->device traffic; D2H is the narrow direction on
+        # relayed links — PERF.md).  None = return outputs as produced.
+        self.output_host_dtype = (np.dtype(output_host_dtype)
+                                  if output_host_dtype is not None else None)
 
         if compute_dtype is not None:
             variables = _cast_floating(variables, compute_dtype)
@@ -149,11 +158,24 @@ class InferenceEngine:
 
         return jax.tree_util.tree_map(pad_leaf, chunk)
 
-    @staticmethod
-    def _trim(out, n: int):
+    def _trim(self, out, n: int):
         import jax
 
-        return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
+        def gather(a):
+            host = np.asarray(a[:n])
+            # cast float->float only: integer/bool leaves (e.g. argmax
+            # ids) must never be silently floated.  ml_dtypes narrow
+            # floats (bf16/f8) register as kind 'V', not np.floating.
+            src_float = (np.issubdtype(host.dtype, np.floating)
+                         or host.dtype.kind == "V")
+            if (self.output_host_dtype is not None
+                    and host.dtype != self.output_host_dtype
+                    and src_float
+                    and np.issubdtype(self.output_host_dtype, np.floating)):
+                host = host.astype(self.output_host_dtype)
+            return host
+
+        return jax.tree_util.tree_map(gather, out)
 
     @staticmethod
     def _slice(batch, off: int, size: int):

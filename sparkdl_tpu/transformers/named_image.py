@@ -53,17 +53,41 @@ def _cached_model(name: str):
 
 
 def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
-    key = (name, featurize, batch_size)
+    """One cached engine per (model, cut, batch).
+
+    ``SPARKDL_ZOO_COMPUTE_DTYPE=bfloat16`` runs the zoo model in bf16 (the
+    bench's configuration: ~MXU-native, and outputs are fetched in bf16
+    then cast to f32 on the HOST — bit-identical features, half the D2H
+    bytes).  Default stays float32: the reference's scoring contract is
+    f32 end-to-end and the parity oracles are f32.
+    """
+    import os
+
+    cdt_name = os.environ.get("SPARKDL_ZOO_COMPUTE_DTYPE", "").lower()
+    if cdt_name not in ("", "float32", "f32", "bfloat16", "bf16"):
+        raise ValueError(
+            f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; use "
+            f"'bfloat16' or 'float32'")
+    key = (name, featurize, batch_size, cdt_name)
     eng = _ENGINE_CACHE.get(key)
     if eng is None:
+        import jax.numpy as jnp
+
         module, variables = _cached_model(name)
         spec = get_model_spec(name)
         pre = spec.preprocess
+        cdt = jnp.bfloat16 if cdt_name in ("bfloat16", "bf16") else None
 
         def fn(v, x):  # x: uint8 RGB [B,H,W,3]
-            return module.apply(v, pre(x), train=False, features=featurize)
+            xf = pre(x)
+            if cdt is not None:
+                xf = xf.astype(cdt)
+            return module.apply(v, xf, train=False, features=featurize)
 
-        eng = InferenceEngine(fn, variables, device_batch_size=batch_size)
+        eng = InferenceEngine(
+            fn, variables, device_batch_size=batch_size,
+            compute_dtype=cdt,
+            output_host_dtype=np.float32 if cdt is not None else None)
         _ENGINE_CACHE[key] = eng
     return eng
 

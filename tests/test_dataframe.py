@@ -153,3 +153,56 @@ def test_map_blocks_columnar():
     assert seen_sizes == [4, 4, 2]
     with pytest.raises(TypeError, match="RecordBatch"):
         df.map_blocks(lambda rb: rb.to_pylist())
+
+
+def test_column_to_numpy_buffer_path_parity(rng):
+    """Uniform list<float> columns read straight from the values buffer:
+    identical result to the old to_pylist row path, across chunked,
+    sliced, fixed-size-list, and int-typed columns; ragged still raises
+    like np.stack would (via the row path)."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.frame import DataFrame
+
+    x = rng.normal(size=(50, 7)).astype(np.float32)
+    rows = [list(map(float, r)) for r in x]
+    # chunked: two batches
+    tbl = pa.table({"v": pa.chunked_array([
+        pa.array(rows[:20], type=pa.list_(pa.float32())),
+        pa.array(rows[20:], type=pa.list_(pa.float32()))])})
+    got = DataFrame(tbl).column_to_numpy("v")
+    np.testing.assert_array_equal(got, x)
+    assert got.dtype == np.float32
+    # sliced
+    sliced = DataFrame(tbl.slice(5, 11)).column_to_numpy("v")
+    np.testing.assert_array_equal(sliced, x[5:16])
+    # fixed-size list
+    fsl = pa.table({"v": pa.array(rows, type=pa.list_(pa.float32(), 7))})
+    np.testing.assert_array_equal(DataFrame(fsl).column_to_numpy("v"), x)
+    # int lists
+    xi = (x * 10).astype(np.int64)
+    ti = pa.table({"v": pa.array([list(map(int, r)) for r in xi],
+                                 type=pa.list_(pa.int64()))})
+    np.testing.assert_array_equal(DataFrame(ti).column_to_numpy("v"), xi)
+    # ragged -> error (same contract as before)
+    ragged = pa.table({"v": pa.array([[1.0, 2.0], [3.0]],
+                                     type=pa.list_(pa.float32()))})
+    with pytest.raises(Exception):
+        DataFrame(ragged).column_to_numpy("v")
+
+
+def test_column_to_numpy_returns_writable(rng):
+    """The buffer path must hand out a writable array that does NOT alias
+    the Arrow table (the old row path's contract)."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.frame import DataFrame
+
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    df = DataFrame(pa.table({"v": pa.array([list(map(float, r)) for r in x],
+                                           type=pa.list_(pa.float32()))}))
+    got = df.column_to_numpy("v")
+    assert got.flags.writeable
+    got /= 2.0  # must not raise, must not write through
+    again = df.column_to_numpy("v")
+    np.testing.assert_array_equal(again, x)

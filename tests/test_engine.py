@@ -160,3 +160,55 @@ def test_engine_call_bounds_inflight_window(setup, monkeypatch):
         outstanding += 1 if e == "dispatch" else -1
         peak = max(peak, outstanding)
     assert peak <= 3, events
+
+
+def test_output_host_dtype_casts_after_fetch():
+    """output_host_dtype fetches the compute dtype and casts on the host:
+    results are bit-identical to a device-side upcast (bf16->f32 widening
+    is exact) while the gathered buffer is the narrow dtype."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.parallel.engine import InferenceEngine, clear_engine_jit_cache
+
+    clear_engine_jit_cache()
+    w = np.linspace(-1, 1, 12).reshape(3, 4).astype(np.float32)
+
+    def fn_raw(v, x):  # bf16 out
+        return (jnp.asarray(x, jnp.bfloat16) @ v["w"].astype(jnp.bfloat16))
+
+    def fn_up(v, x):   # device-side upcast of the same computation
+        return fn_raw(v, x).astype(jnp.float32)
+
+    x = np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)
+    host_cast = InferenceEngine(fn_raw, {"w": w}, device_batch_size=8,
+                                output_host_dtype=np.float32)(x)
+    assert host_cast.dtype == np.float32
+    # without the option, outputs come back in the compute dtype; the host
+    # cast must be exactly the f32 widening of those bf16 values
+    raw = InferenceEngine(fn_raw, {"w": w}, device_batch_size=8)(x)
+    assert raw.dtype != np.float32
+    np.testing.assert_array_equal(host_cast, raw.astype(np.float32))
+    # and within bf16 tolerance of the device-side-upcast program (XLA may
+    # fuse the upcast and skip the intermediate bf16 rounding, so exact
+    # equality with THAT program is not guaranteed)
+    dev_cast = InferenceEngine(fn_up, {"w": w}, device_batch_size=8)(x)
+    np.testing.assert_allclose(host_cast, dev_cast, rtol=2e-2, atol=2e-2)
+
+
+def test_output_host_dtype_preserves_integer_leaves():
+    """Integer outputs (e.g. argmax class ids) must pass through the
+    host cast untouched."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+
+    def fn(v, x):
+        logits = jnp.asarray(x, jnp.bfloat16) @ v["w"].astype(jnp.bfloat16)
+        return {"scores": logits, "ids": jnp.argmax(logits, axis=-1)}
+
+    w = np.eye(3, dtype=np.float32)
+    x = np.random.default_rng(1).normal(size=(5, 3)).astype(np.float32)
+    out = InferenceEngine(fn, {"w": w}, device_batch_size=8,
+                          output_host_dtype=np.float32)(x)
+    assert out["scores"].dtype == np.float32
+    assert np.issubdtype(out["ids"].dtype, np.integer)

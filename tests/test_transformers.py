@@ -337,3 +337,31 @@ def test_image_mode_packs_outputs_incrementally(fixture_images, monkeypatch):
     assert pack_positions[0] < decode_positions[-1], (
         f"first pack must precede last decode (interleaved streaming); "
         f"events: {events[:40]}")
+
+
+def test_zoo_engine_bf16_env_knob(fake_resnet, image_df, monkeypatch):
+    """SPARKDL_ZOO_COMPUTE_DTYPE=bfloat16 keeps the featurizer contract
+    (f32 feature vectors, same values within bf16 tolerance)."""
+    df = image_df
+    ft = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                             modelName="ResNet50", batchSize=8)
+    base = [r["features"] for r in ft.transform(df).collect()]
+    monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bfloat16")
+    bf16 = [r["features"] for r in ft.transform(df).collect()]
+    assert len(base) == len(bf16)
+    for a, b in zip(base, bf16):
+        if a is None:
+            assert b is None
+            continue
+        a, b = np.asarray(a), np.asarray(b)
+        scale = max(1.0, float(np.abs(a).max()))
+        assert np.abs(a - b).max() / scale < 0.05  # bf16 compute tolerance
+    # the engine itself must hand back f32 (the output_host_dtype cast),
+    # not raw bf16 — the one property the knob's plumbing guarantees
+    eng = ni._zoo_engine("ResNet50", True, 8)
+    out = eng(np.zeros((3, 8, 8, 3), np.uint8))
+    assert out.dtype == np.float32
+    # unknown dtype values are rejected, not silently f32
+    monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "float16")
+    with pytest.raises(ValueError, match="not supported"):
+        ni._zoo_engine("ResNet50", True, 8)
