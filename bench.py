@@ -373,10 +373,14 @@ def bench_config5():
         inputCol="uri", outputCol="preds", labelCol="label",
         modelFunction=ModelFunction(fn=fn, variables={"w": w0}),
         imageLoader=loader, optimizer="sgd",
-        loss="categorical_crossentropy", fitParams={"epochs": 2},
+        loss="categorical_crossentropy",
+        # steps_per_execution: k steps per compiled dispatch (identical
+        # math, parity-tested) — one launch + one loss fetch per k
+        fitParams={"epochs": 2, "steps_per_execution": 4},
         batchSize=64)
-    maps = [{est.fitParams: {"epochs": 2}},
-            {est.fitParams: {"epochs": 2}, est.batchSize: 128}]
+    maps = [{est.fitParams: {"epochs": 2, "steps_per_execution": 4}},
+            {est.fitParams: {"epochs": 2, "steps_per_execution": 4},
+             est.batchSize: 128}]
     est.fit(df, [maps[0]])  # warm: decode + compile
     t0 = time.perf_counter()
     models = est.fit(df, maps)

@@ -287,7 +287,11 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         fp = self.getFitParams()
         common = self._common_fit_kwargs()
         common.update(shuffle=bool(fp.get("shuffle", True)),
-                      seed=int(fp.get("seed", 0)))
+                      seed=int(fp.get("seed", 0)),
+                      # k optimizer steps per compiled dispatch (Keras
+                      # steps_per_execution; fit_data_parallel docstring)
+                      steps_per_execution=int(
+                          fp.get("steps_per_execution", 1)))
         if mesh is not None:
             common["mesh"] = mesh
 
@@ -327,7 +331,9 @@ class ImageFileEstimator(Estimator, HasInputCol, HasLabelCol, HasOutputCol,
         fp = self.getFitParams()
         common = self._common_fit_kwargs()
         common.update(steps_per_epoch=(int(fp["steps_per_epoch"])
-                                       if "steps_per_epoch" in fp else None))
+                                       if "steps_per_epoch" in fp else None),
+                      steps_per_execution=int(
+                          fp.get("steps_per_execution", 1)))
 
         def chunks():
             for rb in source():

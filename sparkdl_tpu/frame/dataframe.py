@@ -199,6 +199,12 @@ class DataFrame:
             for arr in chunks:  # per chunk: no combine_chunks 2GB overflow
                 if len(arr) == 0:
                     continue
+                if arr.flatten().null_count:
+                    # inner nulls: keep the row path's loud semantics
+                    # (TypeError for ints; the buffer path would smuggle
+                    # them through as INT64_MIN/NaN)
+                    parts.append(np.asarray(arr.to_pylist(), dtype=dtype))
+                    continue
                 if pa.types.is_fixed_size_list(pytype):
                     width = pytype.list_size
                 else:

@@ -93,8 +93,65 @@ def resolve_loss(loss) -> Callable:
 # train step
 
 
+class _MultiStepMixin:
+    """Steps-per-execution support shared by both compiled-step flavors.
+
+    ``multi()`` returns a jitted program running a whole STACK of batches
+    (``xs``/``ys`` [k, B, ...], data-axis-sharded on dim 1) through the
+    raw step under ``lax.scan`` — one dispatch + one loss fetch per k
+    optimizer steps (Keras ``steps_per_execution``).  One jit object
+    serves every k: jit's executable cache keys on the stacked shape.
+    Subclasses provide ``raw_step``, ``mesh``, ``replicated``, and
+    ``_state_shardings()`` (the sharding per state leg, in call order).
+    """
+
+    def multi(self, k: int) -> Callable:
+        import jax
+
+        del k  # shape-polymorphic: jit re-specializes per stack length
+        if self.raw_step is None:
+            raise ValueError(
+                "multi() unavailable: step built without raw_step")
+        if self._multi_fn is None:
+            raw = self.raw_step
+            n_state = len(self._state_shardings())
+
+            def run(*args):
+                state, xs, ys = args[:n_state], args[-2], args[-1]
+
+                def body(carry, batch):
+                    out = raw(*carry, batch[0], batch[1])
+                    return tuple(out[:-1]), out[-1]
+
+                carry, losses = jax.lax.scan(body, tuple(state), (xs, ys))
+                return (*carry, losses)
+
+            sh = self._state_shardings()
+            stacked = self.stacked_batch_sharded
+            self._multi_fn = jax.jit(
+                run,
+                in_shardings=(*sh, stacked, stacked),
+                out_shardings=(*sh, self.replicated),
+                donate_argnums=tuple(range(n_state)))
+        return self._multi_fn
+
+    @property
+    def stacked_batch_sharded(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(None, mesh_lib.DATA_AXIS))
+
+    def put_batch_stack(self, xs, ys):
+        """Place [k, B, ...] stacked batches under the stacked data-axis
+        sharding (multi-controller: local rows per host, as put_batch)."""
+        from sparkdl_tpu.parallel.distributed import put_sharded
+
+        sh = self.stacked_batch_sharded
+        return put_sharded(sh, xs), put_sharded(sh, ys)
+
+
 @dataclass
-class TrainStep:
+class TrainStep(_MultiStepMixin):
     """A compiled data-parallel step: (params, opt_state, x, y) ->
     (params, opt_state, loss).  Params/opt_state stay device-resident
     across steps (replicated, or tensor-parallel-sharded on the mesh's
@@ -107,6 +164,15 @@ class TrainStep:
     batch_sharded: Any
     param_shardings: Any = None  # pytree of NamedSharding when TP is on
     opt_shardings: Any = None    # derived from param_shardings (TP only)
+    raw_step: Any = None         # untraced python step, for multi()
+    _multi_fn: Any = None        # lazily built jitted multi-step scan
+
+    def _state_shardings(self):
+        p_sh = (self.param_shardings if self.param_shardings is not None
+                else self.replicated)
+        o_sh = (self.opt_shardings if self.opt_shardings is not None
+                else self.replicated)
+        return (p_sh, o_sh)
 
     def put_state(self, params, opt_state):
         import jax
@@ -306,14 +372,15 @@ def make_train_step(predict_fn: Callable, loss, optimizer,
                        batch_sharded=batch_sharded,
                        param_shardings=param_shardings,
                        opt_shardings=(opt_shardings
-                                      if param_specs is not None else None))
+                                      if param_specs is not None else None),
+                       raw_step=step)
     if cache:
         _STEP_CACHE.put(key, result)
     return result
 
 
 @dataclass
-class TrainStepWithStats:
+class TrainStepWithStats(_MultiStepMixin):
     """Compiled data-parallel step that ALSO updates BatchNorm statistics:
     (params, stats, opt_state, x, y) -> (params, stats, opt_state, loss).
 
@@ -326,6 +393,11 @@ class TrainStepWithStats:
     mesh: Any
     replicated: Any
     batch_sharded: Any
+    raw_step: Any = None
+    _multi_fn: Any = None
+
+    def _state_shardings(self):
+        return (self.replicated,) * 3  # params, stats, opt_state
 
     def put_state(self, params, stats, opt_state):
         import jax
@@ -386,7 +458,8 @@ def make_train_step_with_stats(train_fn: Callable, loss, optimizer,
         donate_argnums=(0, 1, 2))
     result = TrainStepWithStats(step_fn=step_fn, mesh=mesh,
                                 replicated=replicated,
-                                batch_sharded=batch_sharded)
+                                batch_sharded=batch_sharded,
+                                raw_step=step)
     if cache:
         _STEP_CACHE.put(key, result)
     return result
@@ -510,6 +583,50 @@ def _stream_epoch_batches(chunks: Iterable, batch_size: int,
         yield head
 
 
+def _run_grouped_steps(step, with_stats: bool, spe: int, batches,
+                       params, stats, opt_state):
+    """Drive one epoch's batches through the compiled step, packing groups
+    of ``spe`` consecutive steps into one dispatch (``TrainStep.multi``).
+    Returns (params, stats, opt_state, losses) with ``losses`` a list of
+    device scalars/vectors — the caller fetches once per epoch.  Size-1
+    groups (ragged tails, spe=1) reuse the already-compiled 1-step
+    program."""
+    losses = []
+
+    def flush(group):
+        nonlocal params, stats, opt_state
+        if len(group) == 1:
+            bx_d, by_d = step.put_batch(*group[0])
+            if with_stats:
+                params, stats, opt_state, lval = step(
+                    params, stats, opt_state, bx_d, by_d)
+            else:
+                params, opt_state, lval = step(params, opt_state,
+                                               bx_d, by_d)
+            losses.append(lval)
+            return
+        xs = np.stack([g[0] for g in group])
+        ys = np.stack([g[1] for g in group])
+        xs_d, ys_d = step.put_batch_stack(xs, ys)
+        if with_stats:
+            params, stats, opt_state, ls = step.multi(len(group))(
+                params, stats, opt_state, xs_d, ys_d)
+        else:
+            params, opt_state, ls = step.multi(len(group))(
+                params, opt_state, xs_d, ys_d)
+        losses.append(ls)
+
+    group = []
+    for bx, by in batches:
+        group.append((bx, by))
+        if len(group) == spe:
+            flush(group)
+            group = []
+    if group:
+        flush(group)
+    return params, stats, opt_state, losses
+
+
 def fit_data_parallel_stream(predict_fn: Callable, params,
                              epoch_source: Callable[[], Iterable], *,
                              optimizer=None,
@@ -522,7 +639,9 @@ def fit_data_parallel_stream(predict_fn: Callable, params,
                              checkpoint_every_epochs: int = 1,
                              metrics: Optional[Metrics] = None,
                              train_fn: Optional[Callable] = None,
-                             stats: Optional[Any] = None) -> Tuple[Any, list]:
+                             stats: Optional[Any] = None,
+                             steps_per_execution: int = 1
+                             ) -> Tuple[Any, list]:
     """Like :func:`fit_data_parallel` but over a RE-ITERABLE chunk source:
     ``epoch_source() -> iterator of (x_chunk, y_chunk)`` host arrays, called
     once per epoch.  Peak host memory is O(chunk + batch) — datasets larger
@@ -532,6 +651,9 @@ def fit_data_parallel_stream(predict_fn: Callable, params,
     Multi-controller runs REQUIRE ``steps_per_epoch`` (a stream cannot be
     counted in agreement across hosts without a full pass); single-process
     runs derive the step count from the stream itself.
+
+    ``steps_per_execution``: as in :func:`fit_data_parallel` — k steps
+    per compiled dispatch; host residency grows to O(chunk + k x batch).
     """
     import jax
 
@@ -619,21 +741,18 @@ def fit_data_parallel_stream(predict_fn: Callable, params,
         return prefixed(first)
 
     metrics = metrics if metrics is not None else Metrics()
+    spe = max(1, int(steps_per_execution))
     epoch_losses = []
     for epoch in range(start_epoch, epochs):
-        losses = []
-        for bx, by in _stream_epoch_batches(_epoch_chunks(), batch_size,
-                                            num_steps=steps_per_epoch):
-            bx_d, by_d = step.put_batch(bx, by)
-            if with_stats:
-                params, stats, opt_state, lval = step(
-                    params, stats, opt_state, bx_d, by_d)
-            else:
-                params, opt_state, lval = step(params, opt_state, bx_d, by_d)
-            losses.append(lval)
+        params, stats, opt_state, losses = _run_grouped_steps(
+            step, with_stats, spe,
+            _stream_epoch_batches(_epoch_chunks(), batch_size,
+                                  num_steps=steps_per_epoch),
+            params, stats, opt_state)
         if not losses:
             raise ValueError("epoch_source yielded no rows")
-        step_losses = [float(l) for l in losses]
+        step_losses = list(np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1) for l in losses]))
         mean = float(np.mean(step_losses))
         if not np.isfinite(mean):
             from sparkdl_tpu.utils import debug as _debug
@@ -665,7 +784,8 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
                       checkpoint_every_epochs: int = 1,
                       metrics: Optional[Metrics] = None,
                       train_fn: Optional[Callable] = None,
-                      stats: Optional[Any] = None) -> Tuple[Any, list]:
+                      stats: Optional[Any] = None,
+                      steps_per_execution: int = 1) -> Tuple[Any, list]:
     """Fit ``params`` on (x, y) with batch-sharded steps over the mesh.
 
     Returns (fitted params on host, per-epoch mean losses).  The analog of
@@ -681,6 +801,14 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
     every ``checkpoint_every_epochs`` epochs and an interrupted fit resumes
     from the newest checkpoint (SURVEY.md §5 — the capability the reference
     delegated to Spark task retry).
+
+    ``steps_per_execution > 1`` packs that many optimizer steps into ONE
+    compiled program per dispatch (``lax.scan`` over stacked batches —
+    Keras's ``steps_per_execution``): identical math and loss series, one
+    launch + one loss fetch per group.  Ragged epoch tails run as one
+    smaller group (compiled once; tail size is constant across epochs).
+    Host memory per dispatch grows by the factor; checkpoint cadence is
+    unchanged (epoch-granular).
     """
     import jax
 
@@ -761,19 +889,16 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
         params, opt_state = step.put_state(params, opt_state)
 
     metrics = metrics if metrics is not None else Metrics()
+    spe = max(1, int(steps_per_execution))
     epoch_losses = []
     for epoch in range(start_epoch, epochs):
-        losses = []
-        for bx, by in _epoch_batches(x, y, batch_size, epoch, shuffle, seed,
-                                     num_steps=steps_per_epoch):
-            bx_d, by_d = step.put_batch(bx, by)
-            if with_stats:
-                params, stats, opt_state, lval = step(
-                    params, stats, opt_state, bx_d, by_d)
-            else:
-                params, opt_state, lval = step(params, opt_state, bx_d, by_d)
-            losses.append(lval)
-        step_losses = [float(l) for l in losses]
+        params, stats, opt_state, losses = _run_grouped_steps(
+            step, with_stats, spe,
+            _epoch_batches(x, y, batch_size, epoch, shuffle, seed,
+                           num_steps=steps_per_epoch),
+            params, stats, opt_state)
+        step_losses = list(np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1) for l in losses]))
         mean = float(np.mean(step_losses))
         if not np.isfinite(mean):
             from sparkdl_tpu.utils import debug as _debug

@@ -68,6 +68,9 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
         raise ValueError(
             f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; use "
             f"'bfloat16' or 'float32'")
+    # canonicalize before keying: 'bf16' and 'bfloat16' are one engine
+    cdt_name = {"bf16": "bfloat16", "f32": "float32", "": "float32"}.get(
+        cdt_name, cdt_name)
     key = (name, featurize, batch_size, cdt_name)
     eng = _ENGINE_CACHE.get(key)
     if eng is None:

@@ -206,3 +206,16 @@ def test_column_to_numpy_returns_writable(rng):
     got /= 2.0  # must not raise, must not write through
     again = df.column_to_numpy("v")
     np.testing.assert_array_equal(again, x)
+
+
+def test_column_to_numpy_inner_nulls_stay_loud():
+    """A null ELEMENT inside an int list must raise (old row-path
+    contract), never silently become INT64_MIN via the buffer path."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.frame import DataFrame
+
+    df = DataFrame(pa.table({"v": pa.array([[1, None], [3, 4]],
+                                           type=pa.list_(pa.int64()))}))
+    with pytest.raises(TypeError):
+        df.column_to_numpy("v")

@@ -821,3 +821,54 @@ def test_fit_multiple_parallel_checkpoint_dirs(tmp_path, uri_label_df):
             np.asarray(m_seq.getModelFunction().variables["w"]),
             np.asarray(m_par.getModelFunction().variables["w"]),
             rtol=1e-4, atol=1e-6)
+
+
+def test_steps_per_execution_matches_single_step(uri_label_df):
+    """steps_per_execution packs k steps into one dispatch (lax.scan) —
+    the loss series and fitted weights must be IDENTICAL to the one-step
+    loop, including the ragged tail group."""
+    def fit(spe):
+        est = ImageFileEstimator(
+            inputCol="uri", outputCol="preds", labelCol="label",
+            modelFunction=_tiny_trainable_mf(),
+            imageLoader=_loader, optimizer="sgd",
+            loss="categorical_crossentropy",
+            fitParams={"epochs": 3, "shuffle": False,
+                       "steps_per_execution": spe}, batchSize=8)
+        return est.fit(uri_label_df)
+
+    base = fit(1)
+    for spe in (2, 3):  # 16 rows / batch 8 = 2 steps/epoch: even + ragged
+        packed = fit(spe)
+        assert base.trainLosses == pytest.approx(packed.trainLosses,
+                                                 rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(base.getModelFunction().variables["w"]),
+            np.asarray(packed.getModelFunction().variables["w"]),
+            rtol=1e-5, atol=1e-7)
+
+
+def test_steps_per_execution_with_batch_stats(uri_label_df):
+    """spe composes with trainBatchStats: the scanned step updates BN
+    statistics identically to the one-step loop."""
+    def fit(spe):
+        est = ImageFileEstimator(
+            inputCol="uri", outputCol="preds", labelCol="label",
+            modelFunction=_bn_model_function(seed=0),
+            imageLoader=_loader, optimizer="sgd",
+            loss="categorical_crossentropy",
+            fitParams={"epochs": 2, "shuffle": False,
+                       "steps_per_execution": spe},
+            batchSize=8, trainBatchStats=True)
+        return est.fit(uri_label_df)
+
+    base, packed = fit(1), fit(4)
+    assert base.trainLosses == pytest.approx(packed.trainLosses, rel=1e-5)
+    vb = base.getModelFunction().variables
+    vp = packed.getModelFunction().variables
+    np.testing.assert_allclose(
+        np.asarray(vb["batch_stats"]["bn"]["mean"]),
+        np.asarray(vp["batch_stats"]["bn"]["mean"]), rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(vb["params"]["head"]["kernel"]),
+        np.asarray(vp["params"]["head"]["kernel"]), rtol=1e-5, atol=1e-7)

@@ -198,3 +198,35 @@ def test_stream_fit_with_train_batch_stats(fixture_images):
     after = np.asarray(
         model.getModelFunction().variables["batch_stats"]["bn"]["mean"])
     assert not np.allclose(before, after)
+
+
+def test_stream_fit_steps_per_execution_parity():
+    """steps_per_execution on the streaming loop: identical loss series
+    and fitted params to the one-step stream fit (incl. the reservoir-
+    wrapped ragged tail)."""
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.parallel.train import fit_data_parallel_stream
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(44, 6)).astype(np.float32)
+    w_true = rng.normal(size=(6, 1)).astype(np.float32)
+    y = x @ w_true
+
+    def source():
+        for off in range(0, len(x), 10):  # uneven chunks
+            yield x[off:off + 10], y[off:off + 10]
+
+    def predict(p, xb):
+        return jnp.asarray(xb) @ p["w"]
+
+    def fit(spe):
+        return fit_data_parallel_stream(
+            predict, {"w": np.zeros((6, 1), np.float32)}, source,
+            optimizer=optax.sgd(0.05), loss="mse", batch_size=16,
+            epochs=3, steps_per_execution=spe)
+
+    (w1, l1), (w4, l4) = fit(1), fit(4)
+    assert l1 == pytest.approx(l4, rel=1e-5)
+    np.testing.assert_allclose(w1["w"], w4["w"], rtol=1e-5, atol=1e-7)
