@@ -587,10 +587,14 @@ def _run_grouped_steps(step, with_stats: bool, spe: int, batches,
                        params, stats, opt_state):
     """Drive one epoch's batches through the compiled step, packing groups
     of ``spe`` consecutive steps into one dispatch (``TrainStep.multi``).
-    Returns (params, stats, opt_state, losses) with ``losses`` a list of
-    device scalars/vectors — the caller fetches once per epoch.  Size-1
-    groups (ragged tails, spe=1) reuse the already-compiled 1-step
-    program."""
+    Returns (params, stats, opt_state, step_losses) with ``step_losses``
+    the fetched per-step float series (one D2H drain per flush group).
+    Size-1 groups (ragged tails, spe=1) reuse the already-compiled 1-step
+    program.  Batches that are VIEWS into a larger buffer (the streaming
+    batcher slices its chunk concatenation) are copied before being held
+    in a group — otherwise ``spe`` pinned views retain O(spe x chunk)
+    host memory on exactly the larger-than-RAM datasets the stream path
+    exists for."""
     losses = []
 
     def flush(group):
@@ -616,15 +620,20 @@ def _run_grouped_steps(step, with_stats: bool, spe: int, batches,
                 params, opt_state, xs_d, ys_d)
         losses.append(ls)
 
+    def own(a):
+        return a.copy() if (spe > 1 and a.base is not None) else a
+
     group = []
     for bx, by in batches:
-        group.append((bx, by))
+        group.append((own(bx), own(by)))
         if len(group) == spe:
             flush(group)
             group = []
     if group:
         flush(group)
-    return params, stats, opt_state, losses
+    step_losses = [] if not losses else list(np.concatenate(
+        [np.asarray(l, np.float32).reshape(-1) for l in losses]))
+    return params, stats, opt_state, step_losses
 
 
 def fit_data_parallel_stream(predict_fn: Callable, params,
@@ -744,15 +753,13 @@ def fit_data_parallel_stream(predict_fn: Callable, params,
     spe = max(1, int(steps_per_execution))
     epoch_losses = []
     for epoch in range(start_epoch, epochs):
-        params, stats, opt_state, losses = _run_grouped_steps(
+        params, stats, opt_state, step_losses = _run_grouped_steps(
             step, with_stats, spe,
             _stream_epoch_batches(_epoch_chunks(), batch_size,
                                   num_steps=steps_per_epoch),
             params, stats, opt_state)
-        if not losses:
+        if not step_losses:
             raise ValueError("epoch_source yielded no rows")
-        step_losses = list(np.concatenate(
-            [np.asarray(l, np.float32).reshape(-1) for l in losses]))
         mean = float(np.mean(step_losses))
         if not np.isfinite(mean):
             from sparkdl_tpu.utils import debug as _debug
@@ -892,13 +899,14 @@ def fit_data_parallel(predict_fn: Callable, params, x: np.ndarray,
     spe = max(1, int(steps_per_execution))
     epoch_losses = []
     for epoch in range(start_epoch, epochs):
-        params, stats, opt_state, losses = _run_grouped_steps(
+        params, stats, opt_state, step_losses = _run_grouped_steps(
             step, with_stats, spe,
             _epoch_batches(x, y, batch_size, epoch, shuffle, seed,
                            num_steps=steps_per_epoch),
             params, stats, opt_state)
-        step_losses = list(np.concatenate(
-            [np.asarray(l, np.float32).reshape(-1) for l in losses]))
+        if not step_losses:
+            raise ValueError(
+                "fit produced no batches (zero-row dataset?)")
         mean = float(np.mean(step_losses))
         if not np.isfinite(mean):
             from sparkdl_tpu.utils import debug as _debug

@@ -126,7 +126,7 @@ def main():
     if mode == "tp":
         _run_tensor_parallel(pid, nproc, out_path)
         return
-    if mode not in ("arrays", "stream"):
+    if mode not in ("arrays", "arrays_spe", "stream"):
         raise ValueError(f"unknown worker mode {mode!r}")
     params = {"w": np.zeros((5, 1), np.float32)}
     if mode == "stream":
@@ -140,10 +140,14 @@ def main():
             batch_size=8, epochs=3, steps_per_epoch=2,
             mesh=mesh_lib.get_mesh(), checkpoint_dir=ckpt_dir)
     else:
+        # arrays_spe: same fit with k steps per dispatch — exercises
+        # put_batch_stack's multi-process global assembly; the parent
+        # test asserts parity with the one-step "arrays" run
+        spe = 2 if mode == "arrays_spe" else 1
         fitted, losses = fit_data_parallel(
             predict, params, x, y, optimizer=optax.sgd(0.05), loss="mse",
             batch_size=8, epochs=3, seed=0, mesh=mesh_lib.get_mesh(),
-            checkpoint_dir=ckpt_dir)
+            checkpoint_dir=ckpt_dir, steps_per_execution=spe)
 
     with open(out_path, "w") as f:
         json.dump({

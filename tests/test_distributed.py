@@ -243,3 +243,22 @@ def test_two_process_tensor_parallel_fit(tmp_path):
     np.testing.assert_allclose(
         np.asarray(results[0]["body"]),
         oracle["body"].ravel(), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_fit_steps_per_execution(tmp_path):
+    """steps_per_execution under multi-controller: the stacked-batch
+    global assembly (put_batch_stack -> make_array_from_process_local_data
+    with a leading k dim) must produce the same fit as the one-step
+    2-process run."""
+    base = _run_two_process_workers(tmp_path, mode="arrays")
+    (tmp_path / "spe").mkdir()
+    packed = _run_two_process_workers(tmp_path / "spe", mode="arrays_spe")
+    assert all(len(r["losses"]) == 3 for r in packed)
+    np.testing.assert_allclose(packed[0]["w"], packed[1]["w"],
+                               rtol=1e-6, atol=1e-7)
+    # parity with the one-step 2-process fit
+    np.testing.assert_allclose(base[0]["losses"], packed[0]["losses"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(base[0]["w"], packed[0]["w"],
+                               rtol=1e-5, atol=1e-7)

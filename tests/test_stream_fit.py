@@ -230,3 +230,43 @@ def test_stream_fit_steps_per_execution_parity():
     (w1, l1), (w4, l4) = fit(1), fit(4)
     assert l1 == pytest.approx(l4, rel=1e-5)
     np.testing.assert_allclose(w1["w"], w4["w"], rtol=1e-5, atol=1e-7)
+
+
+def test_stream_fit_spe_groups_do_not_pin_chunks():
+    """Grouped steps must not retain chunk-sized view bases: every batch
+    held in a pending group owns its memory (O(spe x batch) residency,
+    not O(spe x chunk))."""
+    from sparkdl_tpu.parallel.train import _run_grouped_steps
+
+    seen = []
+
+    class _SpyStep:
+        def put_batch(self, bx, by):
+            seen.append((bx, by))
+            return bx, by
+
+        def put_batch_stack(self, xs, ys):
+            return xs, ys
+
+        def multi(self, k):
+            def run(params, opt_state, xs, ys):
+                for b in range(xs.shape[0]):
+                    seen.append((xs[b], ys[b]))
+                return params, opt_state, np.zeros(xs.shape[0], np.float32)
+
+            return run
+
+        def __call__(self, params, opt_state, bx, by):
+            return params, opt_state, np.float32(0)
+
+    big = np.arange(1000 * 4, dtype=np.float32).reshape(1000, 4)
+    bigy = np.arange(1000, dtype=np.float32)
+
+    def batches():
+        for off in range(0, 64, 8):
+            yield big[off:off + 8], bigy[off:off + 8]  # views into big
+
+    _run_grouped_steps(_SpyStep(), False, 4, batches(), {}, None, {})
+    # stacked groups were built from OWNED copies, not views of `big`
+    for bx, by in seen:
+        assert bx.base is not big and by.base is not bigy
