@@ -68,6 +68,23 @@ class Xception(nn.Module):
             return nn.BatchNorm(use_running_average=not train, momentum=0.99,
                                 epsilon=1e-3, name=name)
 
+        def bn_act(x, name, relu=False):
+            """Inference BN in fused mode folds to a precomputed affine
+            (scale/shift derived in f32 from the running stats — BNAffine)
+            applied in x's dtype.  vs nn.BatchNorm this keeps the folded
+            constants at full precision even when the engine has cast all
+            variables (incl. running var) to bf16, and keeps the epilogue
+            a two-op elementwise chain in the activation dtype.  Identical
+            variable tree either way."""
+            if fused:
+                s, t = BNAffine(epsilon=1e-3, name=name)(x.shape[-1])
+                y = x * s.astype(x.dtype) + t.astype(x.dtype)
+                if relu:
+                    y = nn.relu(y)
+                return y
+            y = bn(name)(x)
+            return nn.relu(y) if relu else y
+
         def sep(x, filters, name, pre_relu=False, post_relu=False,
                 flat_hw=None):
             """sepconv + BN (+ neighboring ReLUs).  When ``fused`` and a
@@ -85,9 +102,7 @@ class Xception(nn.Module):
             if pre_relu:
                 x = nn.relu(x)
             x = SeparableConv2D(filters, (3, 3), use_bias=False, name=name)(x)
-            x = bn(f"{name}_bn")(x)
-            if post_relu:
-                x = nn.relu(x)
+            x = bn_act(x, f"{name}_bn", relu=post_relu)
             return x
 
         if fused:
@@ -96,10 +111,10 @@ class Xception(nn.Module):
         # Entry flow: two plain convs (VALID, stride-2 first)
         x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
                     use_bias=False, name="block1_conv1")(x)
-        x = nn.relu(bn("block1_conv1_bn")(x))
+        x = bn_act(x, "block1_conv1_bn", relu=True)
         x = nn.Conv(64, (3, 3), padding="VALID", use_bias=False,
                     name="block1_conv2")(x)
-        x = nn.relu(bn("block1_conv2_bn")(x))
+        x = bn_act(x, "block1_conv2_bn", relu=True)
 
         # Entry-flow residual blocks (block2 has no leading relu — upstream
         # quirk preserved).  Fused mode routes block4 (37x37, VMEM-sized)
@@ -107,7 +122,7 @@ class Xception(nn.Module):
         for i, f in _ENTRY_BLOCKS:
             residual = nn.Conv(f, (1, 1), strides=(2, 2), padding="SAME",
                                use_bias=False, name=f"shortcut{i}_conv")(x)
-            residual = bn(f"shortcut{i}_bn")(residual)
+            residual = bn_act(residual, f"shortcut{i}_bn")
             if fused and i == 4:
                 h, w = x.shape[1], x.shape[2]
                 xf = pad_to_flat(x, h, w)
@@ -146,7 +161,7 @@ class Xception(nn.Module):
         # Exit flow
         residual = nn.Conv(1024, (1, 1), strides=(2, 2), padding="SAME",
                            use_bias=False, name="shortcut13_conv")(x19)
-        residual = bn("shortcut13_bn")(residual)
+        residual = bn_act(residual, "shortcut13_bn")
         if fused:
             h, w = x19.shape[1], x19.shape[2]
             xf = sep(xf, 728, "block13_sepconv1", pre_relu=True,

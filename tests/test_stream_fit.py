@@ -233,16 +233,19 @@ def test_stream_fit_steps_per_execution_parity():
 
 
 def test_stream_fit_spe_groups_do_not_pin_chunks():
-    """Grouped steps must not retain chunk-sized view bases: every batch
-    held in a pending group owns its memory (O(spe x batch) residency,
-    not O(spe x chunk))."""
-    from sparkdl_tpu.parallel.train import _run_grouped_steps
+    """Grouped steps must not retain chunk-sized view bases: while a group
+    of spe batches is PENDING, every previously-yielded chunk must already
+    be collectable (O(spe x batch) residency, not O(spe x chunk)).
+    Checked with weakrefs from inside the batch generator — a version of
+    _run_grouped_steps that holds raw views keeps each chunk's base alive
+    through the pending group and fails here."""
+    import gc
+    import weakref
 
-    seen = []
+    from sparkdl_tpu.parallel.train import _run_grouped_steps
 
     class _SpyStep:
         def put_batch(self, bx, by):
-            seen.append((bx, by))
             return bx, by
 
         def put_batch_stack(self, xs, ys):
@@ -250,8 +253,6 @@ def test_stream_fit_spe_groups_do_not_pin_chunks():
 
         def multi(self, k):
             def run(params, opt_state, xs, ys):
-                for b in range(xs.shape[0]):
-                    seen.append((xs[b], ys[b]))
                 return params, opt_state, np.zeros(xs.shape[0], np.float32)
 
             return run
@@ -259,14 +260,22 @@ def test_stream_fit_spe_groups_do_not_pin_chunks():
         def __call__(self, params, opt_state, bx, by):
             return params, opt_state, np.float32(0)
 
-    big = np.arange(1000 * 4, dtype=np.float32).reshape(1000, 4)
-    bigy = np.arange(1000, dtype=np.float32)
+    chunk_refs = []
 
     def batches():
-        for off in range(0, 64, 8):
-            yield big[off:off + 8], bigy[off:off + 8]  # views into big
+        for i in range(8):
+            chunk = np.full((1000, 4), i, np.float32)  # one "big" chunk
+            chunk_refs.append(weakref.ref(chunk))
+            gc.collect()
+            # every chunk except the immediately-previous one (the
+            # consumer's loop variable legitimately holds that view until
+            # its next assignment) must be dead, even though up to spe-1
+            # batches sit in the pending group
+            alive = [j for j, r in enumerate(chunk_refs[:-2])
+                     if r() is not None]
+            assert not alive, f"chunks {alive} pinned by the pending group"
+            yield chunk[:8], np.zeros(8, np.float32)
+            del chunk
 
     _run_grouped_steps(_SpyStep(), False, 4, batches(), {}, None, {})
-    # stacked groups were built from OWNED copies, not views of `big`
-    for bx, by in seen:
-        assert bx.base is not big and by.base is not bigy
+    assert len(chunk_refs) == 8  # the stream actually ran
