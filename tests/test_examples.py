@@ -1,0 +1,28 @@
+"""Executable-docs guard: the migration example must keep running as the
+APIs evolve (it is the reference-user's entry document)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_migration_example_runs(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": REPO,
+        "TF_CPP_MIN_LOG_LEVEL": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "migrate_from_sparkdl.py")],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert '{"migration_smoke": "ok"}' in proc.stdout
