@@ -59,6 +59,7 @@ ZOO_GFLOP_PER_IMG = {
     "InceptionV3": 10.997,  # 299x299
     "ResNet50": 7.522,      # 224x224
     "VGG16": 29.972,        # 224x224
+    "VGG19": 37.951,        # 224x224
     "Xception": 16.799,     # 299x299
 }
 
@@ -100,11 +101,14 @@ def _print_line(line):
 def emit(config, metric, value, unit, baseline_model=None, env_bound=None):
     """One self-describing JSON line.  ``baseline_model`` resolves the
     per-model denominator (vs_baseline = value / denominator); lines with
-    no defensible denominator emit vs_baseline null.  ``env_bound`` marks
-    values capped by this sandbox rather than the framework (PERF.md)."""
+    no defensible denominator emit vs_baseline null.  FLOP-scaled lines
+    also carry ``vs_sourced_anchor`` (value / the single sourced 875
+    anchor) so the denominator-method sensitivity is visible in the JSON
+    itself, not only in BASELINE.md prose.  ``env_bound`` marks values
+    capped by this sandbox rather than the framework (PERF.md)."""
     denom, basis = v100_baseline(baseline_model) if baseline_model else (
         None, None)
-    line = json.dumps({
+    rec = {
         "config": config, "metric": metric, "value": round(float(value), 2),
         "unit": unit,
         "vs_baseline": (round(float(value) / denom, 3)
@@ -112,9 +116,59 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None):
         "baseline": ({"ips": round(denom, 1), "basis": basis}
                      if denom is not None else None),
         "env_bound": env_bound,
-    })
+    }
+    if basis is not None and basis.startswith("flop-scaled"):
+        rec["vs_sourced_anchor"] = round(float(value) / V100_BASELINE_IPS, 3)
+    line = json.dumps(rec)
     _LINES[config] = line
     _print_line(line)
+
+
+def measure_relay_profile():
+    """Per-round relay facts: H2D/D2H effective bandwidth + dispatch round
+    trip.  The relay's profile has flipped between rounds (round 3: H2D
+    ~10 MB/s; round 4: H2D ~1.3 GB/s with D2H the narrow direction), so
+    env_bound annotations must not inherit stale numbers — this runs at
+    bench start and its line lands in BENCH_r*.json."""
+    import jax
+    import jax.numpy as jnp
+
+    prof = {}
+    # dispatch+fetch round trip: trivial program, scalar result
+    one = jnp.float32(1.0)
+    f = jax.jit(lambda x: x + 1)
+    float(f(one))  # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(f(one))
+    prof["dispatch_ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
+    # H2D: 16 MB uint8
+    host = np.zeros((16, 1024, 1024), np.uint8)
+    jax.device_put(host[:1]).block_until_ready()
+    t0 = time.perf_counter()
+    jax.device_put(host).block_until_ready()
+    prof["h2d_MBps"] = round(16 / (time.perf_counter() - t0), 1)
+    # D2H: 1 MB fetch (the scoring-path shape class)
+    dev = jax.device_put(np.zeros((1024, 1024), np.uint8))
+    dev.block_until_ready()
+    np.asarray(dev[:1])  # small fetch to absorb any first-fetch setup
+    t0 = time.perf_counter()
+    np.asarray(dev)
+    prof["d2h_MBps"] = round(1 / (time.perf_counter() - t0), 1)
+    return prof
+
+
+RELAY = {}
+
+
+def _relay_tag():
+    """Self-describing env_bound prefix carrying THIS round's measured
+    relay profile (falls back to the PERF.md shorthand if the preamble
+    failed)."""
+    if not RELAY:
+        return "relay(unmeasured this run)"
+    return ("relay(measured: dispatch ~{dispatch_ms}ms/rt, h2d "
+            "~{h2d_MBps}MB/s, d2h ~{d2h_MBps}MB/s)").format(**RELAY)
 
 
 def _compute_dtype():
@@ -241,16 +295,16 @@ def bench_config1_e2e():
     ips = rows / elapsed / eng.num_devices
     emit("1-e2e", "InceptionV3 featurization from JPEG bytes (host decode)",
          ips, "images/sec/chip", baseline_model="InceptionV3",
-         env_bound="d2h-relay(~1-6MB/s,~120ms/rt)+1vcpu-host (PERF.md: "
-                   "feature gather + single-core decode bound, not chip- "
-                   "or framework-bound)")
+         env_bound=_relay_tag() + "+1vcpu-host (PERF.md: feature gather "
+                   "+ single-core decode bound, not chip- or "
+                   "framework-bound)")
 
 
 def bench_config2():
     # MobileNetV2 is the beyond-reference zoo extension (PERF.md fleet);
     # it has no era denominator -> vs_baseline null.  Distinct config
-    # keys per model (ADVICE r3): a driver keyed by config sees all four.
-    for name in ("ResNet50", "Xception", "VGG16", "MobileNetV2"):
+    # keys per model (ADVICE r3): a driver keyed by config sees all five.
+    for name in ("ResNet50", "Xception", "VGG16", "VGG19", "MobileNetV2"):
         fn, variables, (h, w) = _zoo_fn(name, featurize=False)
         steps = STEPS * 2  # amortize the fixed relay fetch cost
         ips = measure_scan(fn, variables, h, w, BATCH, steps)
@@ -286,7 +340,7 @@ def bench_config3():
     elapsed = time.perf_counter() - t0
     assert len(out) == n
     emit("3", "KerasTransformer user-MLP rows/sec", n / elapsed, "rows/sec",
-         env_bound="relay-dispatch(~120ms/rt)+d2h(~1-6MB/s) (PERF.md)")
+         env_bound=_relay_tag() + " (PERF.md)")
 
 
 def bench_config4():
@@ -330,8 +384,8 @@ def bench_config4():
     assert len(out) == n
     emit("4", "registerKerasImageUDF-style image UDF scoring", n / elapsed,
          "images/sec", baseline_model="InceptionV3",
-         env_bound="d2h-relay(~1-6MB/s,~120ms/rt)+1vcpu-host (PERF.md: "
-                   "probability gather dominates)")
+         env_bound=_relay_tag() + "+1vcpu-host (PERF.md: probability "
+                   "gather dominates)")
 
 
 def bench_config5():
@@ -389,8 +443,7 @@ def bench_config5():
     epochs_total = 2 * len(maps)
     emit("5", "ImageFileEstimator param-grid tuning throughput",
          n * epochs_total / elapsed, "train-images/sec",
-         env_bound="relay-dispatch-per-step(~120ms/rt)+1vcpu-host "
-                   "(PERF.md)")
+         env_bound=_relay_tag() + "-per-step+1vcpu-host (PERF.md)")
 
 
 BENCHES = {
@@ -408,6 +461,11 @@ def main():
     # mid-run, the tracked metric is already on stdout — and its line is
     # RE-EMITTED last so a parse-the-final-line driver still sees it on a
     # complete run.
+    try:
+        RELAY.update(measure_relay_profile())
+        _print_line(json.dumps({"config": "relay", **RELAY}))
+    except Exception as e:  # profile failure must not block the bench
+        _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     default = "1,1e2e,2,3,4,5"
     wanted = os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")
     for key in wanted:
@@ -419,8 +477,11 @@ def main():
             fn()
         except Exception as e:  # one failing config must not kill the rest
             _print_line(json.dumps({"config": key, "error": repr(e)[:300]}))
-    # a parse-the-final-line driver must end on the headline metric
-    # whenever it was measured (even if later configs errored)
+    # re-emit the relay profile near the tail so it survives tail-window
+    # capture, then end on the headline metric whenever it was measured
+    # (even if later configs errored) for a parse-the-final-line driver
+    if RELAY:
+        _print_line(json.dumps({"config": "relay", **RELAY}))
     if "1" in _LINES and _LAST_PRINTED[0] != _LINES["1"]:
         _print_line(_LINES["1"])
 

@@ -53,6 +53,25 @@ def main() -> None:
     # {origin, height, width, nChannels, mode, data} with BGR bytes.
 
     # ------------------------------------------------------------------
+    # 1b. Row-level image manipulation (resize UDF)
+    # reference:
+    #   from sparkdl.image.imageIO import createResizeImageUDF
+    #   df = df.withColumn("resized", createResizeImageUDF([32, 32])(df.image))
+    # Here the same row fn rides DataFrame.map_rows; image structs are
+    # read zero-copy from the Arrow buffers (binary `data` arrives as a
+    # memoryview) and untouched struct columns are re-emitted without a
+    # Python round trip (PERF.md "Zero-copy map_rows").
+    from sparkdl_tpu.image import createResizeImageUDF
+
+    resize = createResizeImageUDF([32, 32])
+    resized = df.map_rows(
+        lambda r: {"image": r["image"], "resized": resize(r["image"])})
+    r0 = next(r for r in resized.collect() if r["resized"] is not None)
+    print(f"createResizeImageUDF via map_rows: "
+          f"{r0['resized']['height']}x{r0['resized']['width']}")
+    assert r0["resized"]["height"] == 32
+
+    # ------------------------------------------------------------------
     # 2. Featurization for transfer learning
     # reference:
     #   from sparkdl import DeepImageFeaturizer

@@ -36,6 +36,10 @@ class ModelSpec:
     feature_size: int                          # featurizer-cut dimensionality
     preprocess_mode: str                       # see models.preprocess
     keras_app: str                             # keras.applications attr name
+    # () -> str tag when module_builder reads process env (e.g. the
+    # InceptionV3 s2d-stem knob); caches keyed on the model name must fold
+    # this tag in (model_variant_key) or they serve stale variants.
+    variant_key_fn: Optional[Callable[[], str]] = None
 
     @property
     def preprocess(self):
@@ -184,22 +188,40 @@ def _populate():
     _registry.register(ModelSpec(
         name="ResNet50", module_builder=ResNet50, input_size=(224, 224),
         feature_size=2048, preprocess_mode="caffe", keras_app="ResNet50"))
+    def _xception_builder():
+        # SPARKDL_XC_TILED=1 routes entry blocks 2-3 through the
+        # row-tiled pallas kernel — measured -24% whole-model, so the
+        # default keeps them on XLA (xception.py tiled_entry / PERF.md)
+        return Xception(tiled_entry=_xc_tiled_enabled())
+
     _registry.register(ModelSpec(
-        name="Xception", module_builder=Xception, input_size=(299, 299),
-        feature_size=2048, preprocess_mode="tf", keras_app="Xception"),
+        name="Xception", module_builder=_xception_builder,
+        input_size=(299, 299),
+        feature_size=2048, preprocess_mode="tf", keras_app="Xception",
+        variant_key_fn=lambda: "tiled" if _xc_tiled_enabled() else ""),
         xception_auto_order)
     def _inception_builder():
         # SPARKDL_S2D_STEM=1 computes stem_conv1 via space-to-depth
-        # (identical variables/math, better MXU occupancy — inception.py)
-        import os
+        # (identical variables/math, better MXU occupancy — inception.py);
+        # SPARKDL_FUSED_HEADS=0 disables the branch-head conv fusion
+        # (default: on at inference — inception.py fused_heads)
+        return InceptionV3(s2d_stem=_s2d_stem_enabled(),
+                           fused_heads=None if _fused_heads_enabled()
+                           else False)
 
-        flag = os.environ.get("SPARKDL_S2D_STEM", "0").lower()
-        return InceptionV3(s2d_stem=flag not in ("0", "", "false"))
+    def _inception_variant():
+        tags = []
+        if _s2d_stem_enabled():
+            tags.append("s2d")
+        if not _fused_heads_enabled():
+            tags.append("nofh")
+        return "+".join(tags)
 
     _registry.register(ModelSpec(
         name="InceptionV3", module_builder=_inception_builder,
         input_size=(299, 299),
-        feature_size=2048, preprocess_mode="tf", keras_app="InceptionV3"),
+        feature_size=2048, preprocess_mode="tf", keras_app="InceptionV3",
+        variant_key_fn=_inception_variant),
         inception_import_order)
     # Beyond the reference's five: edge/efficiency-class backbones (see
     # mobilenet.py / efficientnet.py).
@@ -227,6 +249,42 @@ SUPPORTED_MODELS = _registry.names()
 
 def get_model_spec(name: str) -> ModelSpec:
     return _registry.get(name)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    """Truthy env knob: unset or empty -> ``default``; "0"/"false" (any
+    case) -> False; anything else -> True."""
+    import os
+
+    raw = os.environ.get(name, "").lower()
+    if raw == "":
+        return default
+    return raw not in ("0", "false")
+
+
+def _s2d_stem_enabled() -> bool:
+    return _env_flag("SPARKDL_S2D_STEM", False)
+
+
+def _fused_heads_enabled() -> bool:
+    return _env_flag("SPARKDL_FUSED_HEADS", True)
+
+
+def _xc_tiled_enabled() -> bool:
+    return _env_flag("SPARKDL_XC_TILED", False)
+
+
+def model_variant_key(name: str) -> str:
+    """Environment-dependent build-variant tag for ``name``.
+
+    When a spec's ``module_builder`` reads process env (today:
+    ``SPARKDL_S2D_STEM`` for InceptionV3, via its ``variant_key_fn``), a
+    cache keyed on the model name alone would keep serving the
+    previously-built variant after the env var is toggled.  Cache owners
+    must include this tag in their keys.
+    """
+    spec = _registry.get(name)
+    return spec.variant_key_fn() if spec.variant_key_fn is not None else ""
 
 
 def import_keras_weights(name: str, keras_model, variables: dict) -> dict:

@@ -16,7 +16,7 @@ behavior); featurizer cut = global average pool (2048-d).
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Sequence, Tuple, Union
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -161,14 +161,30 @@ class InceptionV3(nn.Module):
     (``layers.SpaceToDepthConv``): same variables, same math (allclose
     parity pinned in tests/test_models.py), different XLA program.  Off by
     default; the registry builder enables it when ``SPARKDL_S2D_STEM=1``.
-    Measured delta on the bench is recorded in PERF.md."""
+    Measured delta on the bench is recorded in PERF.md.
+
+    ``fused_heads``: at inference, the 2-3 LEADING 1x1 convs of each mixed
+    block's branches (which all read the same block input) run as ONE
+    wider conv — kernels concatenated along output channels, BN folded
+    into the kernel/shift, one ReLU, then split.  Identical math and
+    variables (``ConvBN(fold=True)`` declares the same tree); attacks the
+    "many small matmuls" MFU story the round-4 profile documented (no
+    single fusion >4% of device time).  None = on at inference; disable
+    with ``SPARKDL_FUSED_HEADS=0`` (registry builder) for A/B runs."""
 
     num_classes: int = 1000
     s2d_stem: bool = False
+    fused_heads: Optional[bool] = None
+
+    def _use_fused_heads(self, train: bool) -> bool:
+        if train:
+            return False
+        return True if self.fused_heads is None else self.fused_heads
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False,
                  features: bool = False, logits: bool = False) -> jnp.ndarray:
+        fuse_heads = self._use_fused_heads(train)
 
         def pool(x, p: P):
             if p.kind == "max":
@@ -196,9 +212,51 @@ class InceptionV3(nn.Module):
                     x = jnp.concatenate([a, b], axis=-1)
             return x
 
+        def run_block(x, branches):
+            """One mixed block.  With fused heads, every branch whose
+            first op is a stride-1 1x1 ConvBN is started by one combined
+            conv over the shared block input; remaining ops run per
+            branch from their split slice."""
+            head_idx = [bi for bi, br in enumerate(branches)
+                        if (isinstance(br[0], C) and br[0].kh == 1
+                            and br[0].kw == 1 and br[0].strides == (1, 1))]
+            starts = {}
+            if fuse_heads and len(head_idx) >= 2:
+                import jax.lax as lax
+
+                parts = []
+                for bi in head_idx:
+                    c0 = branches[bi][0]
+                    k, s, t = ConvBN(c0.filters, (1, 1), bn_eps=1e-3,
+                                     bn_scale=False, name=c0.name)(
+                        x, fold=True)
+                    parts.append((c0.filters, k, s, t))
+                # fold the BN scale into the kernel (conv is linear), keep
+                # the conv in the variables' dtype (bf16 under the engine)
+                kdt = parts[0][1].dtype
+                K = jnp.concatenate(
+                    [(k.astype(jnp.float32) * s).astype(kdt)
+                     for _, k, s, _ in parts], axis=-1)
+                T = jnp.concatenate([t for _, _, _, t in parts])
+                y = lax.conv_general_dilated(
+                    x.astype(kdt), K, (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                y = nn.relu(y + T.astype(y.dtype)).astype(x.dtype)
+                off = 0
+                for bi, (f, _, _, _) in zip(head_idx, parts):
+                    starts[bi] = y[..., off:off + f]
+                    off += f
+            outs = []
+            for bi, br in enumerate(branches):
+                if bi in starts:
+                    outs.append(run(starts[bi], br[1:]))
+                else:
+                    outs.append(run(x, br))
+            return jnp.concatenate(outs, axis=-1)
+
         x = run(x, STEM)
         for _, branches in BLOCKS:
-            x = jnp.concatenate([run(x, br) for br in branches], axis=-1)
+            x = run_block(x, branches)
         x = global_avg_pool(x)  # 2048-d featurizer cut
         if features:
             return x

@@ -99,7 +99,8 @@ class SeparableConv2D(nn.Module):
                 h=fused_flat["h"], w=fused_flat["w"],
                 pre_relu=fused_flat.get("pre_relu", False),
                 post_relu=fused_flat.get("post_relu", False),
-                force=fused_flat.get("force"))
+                force=fused_flat.get("force"),
+                row_tile=fused_flat.get("row_tile"))
         dtype = self.dtype or x.dtype
         import jax.lax as lax
 
@@ -142,6 +143,21 @@ class BNAffine(nn.Module):
         t = jnp.asarray(beta, jnp.float32) - \
             jnp.asarray(mean.value, jnp.float32) * s
         return s, t
+
+
+class KernelParam(nn.Module):
+    """Variable-tree twin of ``nn.Conv(use_bias=False)``: declares the
+    identical ``kernel`` param (same name, shape, init) and returns it
+    instead of convolving — lets a parent fuse several branch convs into
+    one wider conv (models/inception.py fused heads) while keeping the
+    per-branch variable tree interchangeable with the plain path."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          self.shape)
 
 
 class DepthwiseConv2D(nn.Module):
@@ -249,7 +265,18 @@ class ConvBN(nn.Module):
     s2d: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 fold: bool = False):
+        if fold:
+            # declare the identical variable tree but return the folded
+            # (kernel, bn_scale, bn_shift) for a parent-level fused conv
+            # (inference only — models/inception.py fused heads)
+            kh, kw = self.kernel_size
+            kernel = KernelParam((kh, kw, x.shape[-1], self.features),
+                                 name="conv")()
+            s, t = BNAffine(epsilon=self.bn_eps, use_scale=self.bn_scale,
+                            name="bn")(self.features)
+            return kernel, s, t
         if self.s2d:
             assert self.padding == "VALID", "s2d requires VALID padding"
             x = SpaceToDepthConv(self.features, self.kernel_size,

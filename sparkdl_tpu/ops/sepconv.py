@@ -53,19 +53,30 @@ def flat_width(w: int) -> int:
     return round_up(w + 2, 8)
 
 
-def pad_to_flat(x, h: int, w: int):
-    """[N, H, W, C] -> padded-flat [N, (H+2)*Wp, C] (halo rows/cols = 0)."""
+def flat_rows(h: int, row_tile: Optional[int] = None) -> int:
+    """Row count of the padded-flat layout: H + 2 halo rows, rounded up to
+    a whole number of row tiles when the tiled kernel will consume it."""
+    return round_up(h + 2, row_tile) if row_tile else h + 2
+
+
+def pad_to_flat(x, h: int, w: int, row_tile: Optional[int] = None):
+    """[N, H, W, C] -> padded-flat [N, rows*Wp, C] (halo rows/cols = 0).
+
+    ``rows`` is H+2, rounded up to a multiple of ``row_tile`` for the
+    row-tiled kernel (extra bottom rows stay zero and are masked)."""
     n, c = x.shape[0], x.shape[-1]
     wp = flat_width(w)
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, wp - w - 1), (0, 0)))
-    return xp.reshape(n, (h + 2) * wp, c)
+    rows = flat_rows(h, row_tile)
+    xp = jnp.pad(x, ((0, 0), (1, rows - h - 1), (1, wp - w - 1), (0, 0)))
+    return xp.reshape(n, rows * wp, c)
 
 
 def unflatten(xf, h: int, w: int):
-    """Padded-flat [N, (H+2)*Wp, C] -> [N, H, W, C] (drops the halo)."""
+    """Padded-flat [N, rows*Wp, C] -> [N, H, W, C] (drops halo/pad rows)."""
     n, c = xf.shape[0], xf.shape[-1]
     wp = flat_width(w)
-    return xf.reshape(n, h + 2, wp, c)[:, 1:h + 1, 1:w + 1, :]
+    rows = xf.shape[1] // wp
+    return xf.reshape(n, rows, wp, c)[:, 1:h + 1, 1:w + 1, :]
 
 
 def _sepconv_kernel(x_ref, dwk_ref, pw_ref, scale_ref, shift_ref, out_ref,
@@ -136,6 +147,104 @@ def _fused_sepconv_tpu(xf, dwk, pw, scale, shift, h, w, pre_relu,
       shift.reshape(1, f).astype(jnp.float32))
 
 
+def _sepconv_tiled_kernel(above_ref, cur_ref, below_ref, dwk_ref, pw_ref,
+                          scale_ref, shift_ref, out_ref,
+                          *, h, w, wp, th, pre_relu, post_relu):
+    """One (batch, row-tile) cell: TH output rows + 1 halo row each side.
+
+    The working buffer is [(TH+2)*Wp, C] — the previous tile's last row,
+    this tile's TH rows, the next tile's first row (fetched as separate
+    Wp-row blocks, so halo re-fetch traffic is 2/TH of the tile, not 2x).
+    Taps roll the whole buffer like the full-image kernel; outputs are
+    computed for the middle TH*Wp positions only, so the roll's wraparound
+    touches only the halo slices and every tap a VALID output reads stays
+    in-bounds.  Edge tiles fetch clamped (garbage) halo blocks whose
+    contributions land exclusively on masked halo/pad rows."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t = pl.program_id(1)
+    lo_t = (th + 2) * wp
+    xt = jnp.concatenate(
+        [above_ref[0], cur_ref[0], below_ref[0]], axis=0).astype(jnp.float32)
+    if pre_relu:
+        xt = jnp.maximum(xt, jnp.float32(0))
+    acc = jnp.zeros(xt.shape, jnp.float32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            delta = (-(dy * wp + dx)) % lo_t
+            tap = pltpu.roll(xt, delta, 0) if delta else xt
+            acc += tap * dwk_ref[dy + 1, dx + 1, :].astype(jnp.float32)
+    y = jax.lax.dot_general(
+        acc[wp:wp + th * wp].astype(jnp.bfloat16), pw_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = y * scale_ref[0, :] + shift_ref[0, :]
+    if post_relu:
+        y = jnp.maximum(y, 0.0)
+    local = jax.lax.broadcasted_iota(jnp.int32, (th * wp, 1), 0)
+    r = t * th + local // wp
+    col = local % wp
+    valid = ((r >= 1) & (r <= h) & (col >= 1) & (col <= w))
+    out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("h", "w", "th", "pre_relu", "post_relu", "interpret"))
+def _fused_sepconv_tpu_tiled(xf, dwk, pw, scale, shift, h, w, th, pre_relu,
+                             post_relu, interpret=False):
+    """Row-tiled variant for shapes whose full image exceeds VMEM (the
+    147^2/74^2 entry-flow sepconvs).  Grid (batch, row-tile); the input
+    must be padded-flat with rows = round_up(H+2, th)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, lo, c = xf.shape
+    f = pw.shape[-1]
+    wp = flat_width(w)
+    rows = lo // wp
+    assert lo == rows * wp and rows % th == 0, (lo, wp, rows, th)
+    assert rows >= h + 2, (rows, h)
+    nt = rows // th
+    kernel = functools.partial(_sepconv_tiled_kernel, h=h, w=w, wp=wp,
+                               th=th, pre_relu=pre_relu, post_relu=post_relu)
+    return pl.pallas_call(
+        kernel,
+        grid=(n, nt),
+        in_specs=[
+            # prev tile's last row (clamped at the top edge: tile 0 reads
+            # row-block 0, whose contribution is masked)
+            pl.BlockSpec((1, wp, c),
+                         lambda b, t: (b, jnp.maximum(t * th - 1, 0), 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, th * wp, c), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.VMEM),
+            # next tile's first row (clamped at the bottom edge)
+            pl.BlockSpec(
+                (1, wp, c),
+                lambda b, t: (b, jnp.minimum(t * th + th, rows - 1), 0),
+                memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, c), lambda b, t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, f), lambda b, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f), lambda b, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f), lambda b, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, th * wp, f), lambda b, t: (b, t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, lo, f), jnp.bfloat16),
+        interpret=interpret,
+    )(xf.astype(jnp.bfloat16), xf.astype(jnp.bfloat16),
+      xf.astype(jnp.bfloat16), dwk.astype(jnp.bfloat16),
+      pw.astype(jnp.bfloat16),
+      scale.reshape(1, f).astype(jnp.float32),
+      shift.reshape(1, f).astype(jnp.float32))
+
+
 def sepconv_reference(x, dwk, pw, scale, shift, pre_relu: bool,
                       post_relu: bool = False):
     """Pure-jax twin of the kernel (parity oracle / non-TPU fallback) in
@@ -175,16 +284,23 @@ def _on_tpu() -> bool:
 
 def fused_sepconv_flat(xf, dwk, pw, scale, shift, h: int, w: int,
                        pre_relu: bool = False, post_relu: bool = False,
-                       force: Optional[bool] = None):
+                       force: Optional[bool] = None,
+                       row_tile: Optional[int] = None):
     """Fused sepconv+BN on PADDED-FLAT input/output (see module doc).
 
-    ``xf`` [N, (H+2)*Wp, C] with zeroed halo; returns [N, (H+2)*Wp, F]
+    ``xf`` [N, rows*Wp, C] with zeroed halo; returns [N, rows*Wp, F]
     with zeroed halo — directly consumable by the next stride-1 sepconv.
     ``dwk`` [3,3,C] or [3,3,C,1]; ``pw`` [C,F] or [1,1,C,F].  Dispatches
     to the pallas kernel on TPU backends, to the NHWC reference (with
     pack/unpack) elsewhere; ``force`` overrides, and
     ``force="interpret"`` runs the REAL kernel through the pallas
     interpreter (CI parity on CPU).
+
+    ``row_tile``: process TH rows per grid cell instead of the whole
+    image — required when (H+2)*Wp*C exceeds VMEM (the 147^2/74^2
+    entry-flow shapes).  The input must have rows = round_up(H+2, TH)
+    (``pad_to_flat(..., row_tile=TH)``); chains of equal-shape sepconvs
+    still need no repacking.
     """
     if dwk.ndim == 4:
         dwk = dwk.reshape(3, 3, -1)
@@ -192,9 +308,18 @@ def fused_sepconv_flat(xf, dwk, pw, scale, shift, h: int, w: int,
         pw = pw.reshape(pw.shape[-2], pw.shape[-1])
     use_pallas = _on_tpu() if force is None else force
     if use_pallas:
+        interpret = (force == "interpret")
+        if row_tile:
+            return _fused_sepconv_tpu_tiled(xf, dwk, pw, scale, shift, h,
+                                            w, row_tile, pre_relu,
+                                            post_relu, interpret=interpret)
         return _fused_sepconv_tpu(xf, dwk, pw, scale, shift, h, w,
-                                  pre_relu, post_relu,
-                                  interpret=(force == "interpret"))
+                                  pre_relu, post_relu, interpret=interpret)
+    rows = xf.shape[1] // flat_width(w)
     x = unflatten(xf, h, w)
     y = sepconv_reference(x, dwk, pw, scale, shift, pre_relu, post_relu)
-    return pad_to_flat(y, h, w)
+    yf = pad_to_flat(y, h, w)
+    wp = flat_width(w)
+    if rows > h + 2:  # preserve the caller's row padding
+        yf = jnp.pad(yf, ((0, 0), (0, (rows - h - 2) * wp), (0, 0)))
+    return yf

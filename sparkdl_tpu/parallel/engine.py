@@ -48,6 +48,23 @@ def clear_engine_jit_cache() -> None:
     _JIT_CACHE.clear()
 
 
+def _is_narrow_float(dtype) -> bool:
+    """True iff ``dtype`` is an ml_dtypes narrow float (bf16/f8 families).
+
+    These register as numpy kind 'V' (void), which also covers structured
+    dtypes — ``ml_dtypes.finfo`` accepts only the float ones.
+    """
+    if np.dtype(dtype).kind != "V":
+        return False
+    try:
+        import ml_dtypes
+
+        ml_dtypes.finfo(dtype)
+        return True
+    except (ImportError, ValueError, TypeError, KeyError):
+        return False
+
+
 def _cast_floating(variables, dtype):
     import jax
     import jax.numpy as jnp
@@ -165,9 +182,11 @@ class InferenceEngine:
             host = np.asarray(a[:n])
             # cast float->float only: integer/bool leaves (e.g. argmax
             # ids) must never be silently floated.  ml_dtypes narrow
-            # floats (bf16/f8) register as kind 'V', not np.floating.
+            # floats (bf16/f8) register as kind 'V', not np.floating —
+            # but so do genuinely structured/void dtypes, which must
+            # pass through untouched, so probe ml_dtypes explicitly.
             src_float = (np.issubdtype(host.dtype, np.floating)
-                         or host.dtype.kind == "V")
+                         or _is_narrow_float(host.dtype))
             if (self.output_host_dtype is not None
                     and host.dtype != self.output_host_dtype
                     and src_float

@@ -21,7 +21,7 @@ import pyarrow as pa
 
 from sparkdl_tpu.image.io import arrowStructsToBatch
 from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
-from sparkdl_tpu.models import get_model_spec, load_model
+from sparkdl_tpu.models import get_model_spec, load_model, model_variant_key
 from sparkdl_tpu.models.imagenet import decode_predictions
 from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
@@ -37,7 +37,7 @@ logger = get_logger(__name__)
 # Process-wide caches: zoo weights load once, engines compile once per
 # (model, purpose, batch).  The analog of the reference broadcasting one
 # GraphDef per stage rather than per partition.
-_MODEL_CACHE: Dict[str, tuple] = {}
+_MODEL_CACHE: Dict[tuple, tuple] = {}
 _ENGINE_CACHE: Dict[tuple, InferenceEngine] = {}
 
 
@@ -47,9 +47,12 @@ def clear_model_caches():
 
 
 def _cached_model(name: str):
-    if name not in _MODEL_CACHE:
-        _MODEL_CACHE[name] = load_model(name)
-    return _MODEL_CACHE[name]
+    # key includes the env-dependent build variant (e.g. SPARKDL_S2D_STEM)
+    # so toggling the knob mid-process rebuilds instead of serving stale
+    key = (name, model_variant_key(name))
+    if key not in _MODEL_CACHE:
+        _MODEL_CACHE[key] = load_model(name)
+    return _MODEL_CACHE[key]
 
 
 def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
@@ -71,7 +74,7 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
     # canonicalize before keying: 'bf16' and 'bfloat16' are one engine
     cdt_name = {"bf16": "bfloat16", "f32": "float32", "": "float32"}.get(
         cdt_name, cdt_name)
-    key = (name, featurize, batch_size, cdt_name)
+    key = (name, model_variant_key(name), featurize, batch_size, cdt_name)
     eng = _ENGINE_CACHE.get(key)
     if eng is None:
         import jax.numpy as jnp

@@ -130,6 +130,88 @@ def test_map_rows_missing_key_null_fills():
     assert [r["c"] for r in out.collect()] == [None, None, "x", "x"]
 
 
+def _image_frame(n=8, h=16, w=12, null_at=3):
+    import pyarrow as pa
+
+    from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
+
+    rng = np.random.default_rng(0)
+    structs = [imageArrayToStruct(
+        (rng.random((h, w, 3)) * 255).astype(np.uint8), origin=f"r{i}")
+        for i in range(n)]
+    if null_at is not None:
+        structs[null_at] = None
+    return DataFrame(pa.table({"image": pa.array(structs, type=imageSchema),
+                               "k": list(range(n))}))
+
+
+def test_map_rows_struct_zero_copy_passthrough():
+    """VERDICT r4 #6: struct columns ride Arrow-buffer views through
+    map_rows.  A struct returned untouched is re-emitted as the ORIGINAL
+    Arrow column (no Python->Arrow round trip) and null rows survive."""
+    df = _image_frame()
+    seen_types = []
+    out = df.map_rows(lambda r: seen_types.append(type(
+        r["image"] and r["image"]["data"])) or
+        {"image": r["image"], "k2": r["k"] * 2}, batch_size=3)
+    # fn saw zero-copy views: binary child is a memoryview, not bytes
+    assert memoryview in seen_types
+    assert out.count() == 8
+    assert out.table.column("image").null_count == 1
+    assert out.table.column("image").combine_chunks().equals(
+        df.table.column("image").combine_chunks())
+    assert [r["k2"] for r in out.collect()] == [i * 2 for i in range(8)]
+
+
+def test_map_rows_struct_modified_and_nulled():
+    """Modified structs materialize normally (resize UDF path) and a fn
+    nulling a live row defeats the passthrough, not the null contract."""
+    from sparkdl_tpu.image.io import createResizeImageUDF
+
+    df = _image_frame()
+    resize = createResizeImageUDF([4, 4])
+    out = df.map_rows(lambda r: {"image": resize(r["image"])}, batch_size=3)
+    rows = out.table.column("image").to_pylist()
+    assert rows[3] is None
+    assert rows[0]["height"] == 4 and rows[0]["width"] == 4
+    assert len(rows[0]["data"]) == 4 * 4 * 3
+
+    out2 = df.map_rows(
+        lambda r: {"image": None if r["k"] in (0, 3) else r["image"]},
+        batch_size=4)
+    assert out2.table.column("image").null_count == 2
+    kept = out2.table.column("image").to_pylist()[1]
+    assert kept == df.table.column("image").to_pylist()[1]
+
+
+def test_map_rows_struct_inplace_mutation_preserved():
+    """A fn that mutates the struct view IN PLACE and returns it must see
+    its mutation in the output (the old to_pylist behavior) — dirty views
+    defeat the zero-copy passthrough."""
+    df = _image_frame(n=4, null_at=None)
+
+    def mutate(r):
+        img = r["image"]
+        img["origin"] = "MUTATED"
+        return {"image": img}
+
+    out = df.map_rows(mutate, batch_size=2)
+    assert [r["origin"] for r in
+            out.table.column("image").to_pylist()] == ["MUTATED"] * 4
+
+
+def test_map_rows_struct_view_survives_arrow_rebuild():
+    """A view forwarded under a different batch alignment (shifted rows)
+    must materialize correctly — identity passthrough only fires for
+    row-aligned returns."""
+    df = _image_frame(n=4, null_at=None)
+    cache = []
+    out = df.map_rows(lambda r: cache.append(r["image"]) or
+                      {"image": cache[0]}, batch_size=4)
+    rows = out.table.column("image").to_pylist()
+    assert all(r["origin"] == "r0" for r in rows)
+
+
 def test_map_blocks_columnar():
     """Block-wise map (TensorFrames map_blocks parity): fn sees record
     batches, never per-row Python objects, and may change the layout."""
