@@ -94,12 +94,10 @@ def _struct_view_rows(arr: "pa.StructArray"):
         f = arr.type.field(i)
         child = arr.field(i)
         t = f.type
-        if child.null_count == 0 and (
-                pa.types.is_integer(t) or pa.types.is_floating(t)):
-            np_child = child.to_numpy(zero_copy_only=False)
-            cols.append((f.name, "num", np_child))
-        elif child.null_count == 0 and (
-                pa.types.is_binary(t) or pa.types.is_large_binary(t)):
+        if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+            if child.null_count:  # per-CHILD fallback: the other children
+                cols.append((f.name, "py", child.to_pylist()))  # stay fast
+                continue
             bufs = child.buffers()
             odt = np.int64 if pa.types.is_large_binary(t) else np.int32
             offs = np.frombuffer(bufs[1], odt)[
@@ -107,6 +105,10 @@ def _struct_view_rows(arr: "pa.StructArray"):
             data_mv = memoryview(bufs[2]) if bufs[2] is not None else \
                 memoryview(b"")
             cols.append((f.name, "bin", (offs, data_mv)))
+        elif child.null_count == 0 and (
+                pa.types.is_integer(t) or pa.types.is_floating(t)):
+            np_child = child.to_numpy(zero_copy_only=False)
+            cols.append((f.name, "num", np_child))
         elif (pa.types.is_string(t) or pa.types.is_large_string(t)
               or pa.types.is_boolean(t) or pa.types.is_integer(t)
               or pa.types.is_floating(t) or pa.types.is_null(t)):

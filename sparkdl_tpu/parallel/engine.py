@@ -48,6 +48,16 @@ def clear_engine_jit_cache() -> None:
     _JIT_CACHE.clear()
 
 
+def batches_per_dispatch_from_env() -> int:
+    """``SPARKDL_BATCHES_PER_DISPATCH`` (clamped to >= 1) — the one
+    parser every engine-constructing site shares, so cache keys and
+    defaults cannot drift."""
+    import os
+
+    raw = os.environ.get("SPARKDL_BATCHES_PER_DISPATCH", "") or "1"
+    return max(1, int(raw))
+
+
 def _is_narrow_float(dtype) -> bool:
     """True iff ``dtype`` is an ml_dtypes narrow float (bf16/f8 families).
 
@@ -92,6 +102,7 @@ class InferenceEngine:
                  compute_dtype: Optional[Any] = None,
                  output_host_dtype: Optional[Any] = None,
                  donate_batch: bool = False,
+                 batches_per_dispatch: int = 1,
                  metrics: Optional[Metrics] = None):
         import jax
 
@@ -116,6 +127,15 @@ class InferenceEngine:
         self.output_host_dtype = (np.dtype(output_host_dtype)
                                   if output_host_dtype is not None else None)
 
+        # k host batches per compiled dispatch (lax.map over a stacked
+        # leading group axis): one launch + one result fetch per k batches
+        # — the inference analog of the train loop's steps_per_execution.
+        # Identical per-batch math (lax.map is a scan, not a vmap, so
+        # nothing about the batch dimension the model sees changes); wins
+        # whenever dispatch/fetch latency rivals compute (relayed links,
+        # multi-host pods).  k=1 is the plain program.
+        self.batches_per_dispatch = max(1, int(batches_per_dispatch))
+
         if compute_dtype is not None:
             variables = _cast_floating(variables, compute_dtype)
         self._replicated = mesh_lib.replicated_sharding(self.mesh)
@@ -123,9 +143,9 @@ class InferenceEngine:
         # Params live on device once — the NamedSharding replicate is the TPU
         # analog of the reference's model-GraphDef broadcast.
         self.variables = jax.device_put(variables, self._replicated)
-        key = (id(fn),
-               tuple(d.id for d in self.mesh.devices.flat),
-               tuple(self.mesh.axis_names), bool(donate_batch))
+        mesh_key = (tuple(d.id for d in self.mesh.devices.flat),
+                    tuple(self.mesh.axis_names), bool(donate_batch))
+        key = (id(fn),) + mesh_key + (1,)
         compiled = _JIT_CACHE.get(key)
         if compiled is None:
             compiled = jax.jit(
@@ -134,7 +154,29 @@ class InferenceEngine:
                 out_shardings=self._batch_sharding,
                 donate_argnums=(1,) if donate_batch else ())
             _JIT_CACHE.put(key, compiled)
+        # the plain per-batch program always exists: it runs run_padded
+        # and the ragged tail group (cheaper than padding a group with
+        # full zero batches that would execute the whole model)
         self._compiled = compiled
+        if self.batches_per_dispatch > 1:
+            gkey = (id(fn),) + mesh_key + (self.batches_per_dispatch,)
+            grouped = _JIT_CACHE.get(gkey)
+            if grouped is None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                group_sh = NamedSharding(
+                    self.mesh, P(None, mesh_lib.DATA_AXIS))
+
+                def fn_group(v, xs):
+                    return jax.lax.map(lambda x: fn(v, x), xs)
+
+                grouped = jax.jit(
+                    fn_group,
+                    in_shardings=(self._replicated, group_sh),
+                    out_shardings=group_sh,
+                    donate_argnums=(1,) if donate_batch else ())
+                _JIT_CACHE.put(gkey, grouped)
+            self._compiled_group = grouped
 
     # -- low level ---------------------------------------------------------
     @staticmethod
@@ -228,30 +270,72 @@ class InferenceEngine:
         return jax.tree_util.tree_map(
             lambda *parts: np.concatenate(parts, axis=0), *outs)
 
+    def _run_group(self, pieces):
+        """Dispatch exactly ``batches_per_dispatch`` ``pieces`` as ONE
+        stacked lax.map program; returns (true_row_counts, device_out)."""
+        import jax
+
+        ns = tuple(self._leaves(p) for p in pieces)
+        stacked = jax.tree_util.tree_map(
+            lambda *parts: np.stack(parts, axis=0),
+            *[self._pad(p) for p in pieces])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P(None, mesh_lib.DATA_AXIS))
+        out = self._compiled_group(self.variables,
+                                   jax.device_put(stacked, sh))
+        return ns, out
+
     # -- streaming API -----------------------------------------------------
     def map_batches(self, batches: Iterable[Any],
                     window: int = 2) -> Iterator[Any]:
         """Map over an iterator of host batches with a bounded in-flight
         window (double buffering by default): batch k+1 transfers/computes
-        while batch k is gathered."""
+        while batch k is gathered.  With ``batches_per_dispatch`` > 1 the
+        window counts GROUPS (one launch of k stacked batches, ONE host
+        fetch per group); a ragged tail group runs its pieces through the
+        plain per-batch program instead of padding with whole zero
+        batches."""
         from collections import deque
 
         import jax
 
         inflight: deque = deque()
+
+        def drain(limit):
+            while len(inflight) > limit:
+                ns, out = inflight.popleft()
+                if isinstance(ns, int):
+                    yield self._trim(out, ns)
+                    continue
+                # one D2H fetch for the whole group, sliced on the host
+                # (per-batch device slicing would pay k fetch round trips
+                # — the latency this knob exists to amortize)
+                host = jax.tree_util.tree_map(np.asarray, out)
+                for i, n in enumerate(ns):
+                    yield self._trim(
+                        jax.tree_util.tree_map(lambda a: a[i], host), n)
+
+        group: list = []
         for chunk in batches:
             chunk = jax.tree_util.tree_map(np.asarray, chunk)
             n = self._leaves(chunk)
             for off in range(0, n, self.device_batch_size):
                 piece = self._slice(chunk, off, self.device_batch_size)
-                inflight.append(
-                    (self._leaves(piece), self.run_padded(self._pad(piece))))
-                if len(inflight) > window:
-                    k, out = inflight.popleft()
-                    yield self._trim(out, k)
-        while inflight:
-            k, out = inflight.popleft()
-            yield self._trim(out, k)
+                if self.batches_per_dispatch == 1:
+                    inflight.append((self._leaves(piece),
+                                     self.run_padded(self._pad(piece))))
+                    yield from drain(window)
+                else:
+                    group.append(piece)
+                    if len(group) == self.batches_per_dispatch:
+                        inflight.append(self._run_group(group))
+                        group = []
+                        yield from drain(window)
+        for piece in group:  # ragged tail: plain program, no zero batches
+            inflight.append((self._leaves(piece),
+                             self.run_padded(self._pad(piece))))
+        yield from drain(0)
 
     @property
     def num_devices(self) -> int:
@@ -268,8 +352,11 @@ def get_cached_engine(holder, model_function, *, device_batch_size: int,
     The cache entry pins the ModelFunction alive so id-keying cannot alias
     a recycled object.
     """
+    engine_kwargs.setdefault("batches_per_dispatch",
+                             batches_per_dispatch_from_env())
     cache = holder.__dict__.setdefault("_engine_cache", {})
-    key = (id(model_function), device_batch_size)
+    key = (id(model_function), device_batch_size,
+           engine_kwargs["batches_per_dispatch"])
     entry = cache.get(key)
     if entry is None:
         eng = InferenceEngine(model_function.fn, model_function.variables,

@@ -27,7 +27,9 @@ from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.params import Param, TypeConverters, keyword_only
 from sparkdl_tpu.param.shared import (HasBatchSize, HasInputCol, HasModelName,
                                       HasOutputCol, HasOutputMode, HasTopK)
-from sparkdl_tpu.parallel.engine import InferenceEngine, get_cached_engine
+from sparkdl_tpu.parallel.engine import (InferenceEngine,
+                                         batches_per_dispatch_from_env,
+                                         get_cached_engine)
 from sparkdl_tpu.persistence import PersistableModelFunctionMixin
 from sparkdl_tpu.transformers.base import Transformer
 from sparkdl_tpu.utils.logging import get_logger
@@ -74,7 +76,9 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
     # canonicalize before keying: 'bf16' and 'bfloat16' are one engine
     cdt_name = {"bf16": "bfloat16", "f32": "float32", "": "float32"}.get(
         cdt_name, cdt_name)
-    key = (name, model_variant_key(name), featurize, batch_size, cdt_name)
+    bpd = batches_per_dispatch_from_env()
+    key = (name, model_variant_key(name), featurize, batch_size, cdt_name,
+           bpd)
     eng = _ENGINE_CACHE.get(key)
     if eng is None:
         import jax.numpy as jnp
@@ -93,6 +97,7 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
         eng = InferenceEngine(
             fn, variables, device_batch_size=batch_size,
             compute_dtype=cdt,
+            batches_per_dispatch=bpd,
             output_host_dtype=np.float32 if cdt is not None else None)
         _ENGINE_CACHE[key] = eng
     return eng

@@ -84,6 +84,72 @@ def test_engine_streaming_window(setup):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_engine_batches_per_dispatch_matches_plain(setup):
+    """Grouped dispatch (k host batches per compiled program via lax.map
+    — the inference analog of steps_per_execution) returns EXACTLY the
+    plain engine's outputs: same rows, same order, ragged tail groups
+    and ragged final batches included."""
+    variables, x, ref = setup
+    plain = InferenceEngine(_fn, variables, device_batch_size=16)
+    grouped = InferenceEngine(_fn, variables, device_batch_size=16,
+                              batches_per_dispatch=3)
+    # 45 rows / 16 = 3 pieces -> one full group of 3 (third piece ragged)
+    # (allclose, not equal: the grouped program's op order differs at the
+    # last ulp, same as any XLA re-fusion)
+    np.testing.assert_allclose(grouped(x), plain(x), rtol=1e-5, atol=1e-6)
+    # streaming, multiple chunks, tail group of 2 of 3: 5 pieces total
+    chunks = [x[:20], x[20:41], x[41:]]
+    got = list(grouped.map_batches(iter(chunks)))
+    want = list(plain.map_batches(iter(chunks)))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.concatenate(got), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_engine_batches_per_dispatch_tail_uses_plain_program(setup,
+                                                             monkeypatch):
+    """A ragged tail group must run its pieces through the plain
+    per-batch program — not pad the group with whole zero batches that
+    would execute the full model for nothing."""
+    variables, x, _ = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=16,
+                          batches_per_dispatch=3)
+    calls = {"group": 0, "plain": 0}
+    orig_group, orig_plain = eng._run_group, eng.run_padded
+    monkeypatch.setattr(eng, "_run_group", lambda p: (
+        calls.__setitem__("group", calls["group"] + 1), orig_group(p))[1])
+    monkeypatch.setattr(eng, "run_padded", lambda b: (
+        calls.__setitem__("plain", calls["plain"] + 1), orig_plain(b))[1])
+    out = eng(np.concatenate([x, x[:19]]))  # 64 rows = 4 pieces: 3 + 1
+    assert out.shape[0] == 64
+    assert calls == {"group": 1, "plain": 1}
+
+
+def test_engine_batches_per_dispatch_pytree(setup):
+    """Grouped dispatch with pytree outputs and integer leaves (argmax
+    ids) — per-leaf group indexing and host-dtype rules must hold."""
+    import jax.numpy as jnp
+
+    variables, x, ref = setup
+
+    def fn(v, xb):
+        y = jnp.tanh(xb @ v["w"] + v["b"])
+        return {"y": y, "ids": jnp.argmax(y, axis=-1)}
+
+    plain = InferenceEngine(fn, variables, device_batch_size=8,
+                            output_host_dtype=np.float32)
+    grouped = InferenceEngine(fn, variables, device_batch_size=8,
+                              batches_per_dispatch=2,
+                              output_host_dtype=np.float32)
+    a, b = plain(x), grouped(x)
+    np.testing.assert_allclose(a["y"], b["y"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(a["ids"], b["ids"])
+    assert b["ids"].dtype.kind in "iu"  # never floated
+
+
 def test_engine_empty_input_rejected(setup):
     variables, x, _ = setup
     eng = InferenceEngine(_fn, variables, device_batch_size=8)
