@@ -175,7 +175,7 @@ def _populate():
     from sparkdl_tpu.models.inception import (InceptionV3,
                                               inception_import_order)
     from sparkdl_tpu.models.mobilenet import MobileNetV2
-    from sparkdl_tpu.models.resnet import ResNet50
+    from sparkdl_tpu.models.resnet import ResNet50, ResNet101, ResNet152
     from sparkdl_tpu.models.vgg import VGG16, VGG19
     from sparkdl_tpu.models.xception import Xception, xception_auto_order
 
@@ -185,9 +185,26 @@ def _populate():
     _registry.register(ModelSpec(
         name="VGG19", module_builder=VGG19, input_size=(224, 224),
         feature_size=4096, preprocess_mode="caffe", keras_app="VGG19"))
-    _registry.register(ModelSpec(
-        name="ResNet50", module_builder=ResNet50, input_size=(224, 224),
-        feature_size=2048, preprocess_mode="caffe", keras_app="ResNet50"))
+    def _resnet_variant():
+        # one helper for the whole family: a second ResNet knob must
+        # change the tag for ResNet50/101/152 together (the InceptionV3
+        # combined-tag lesson)
+        return "fsc" if _rn_fused_shortcut_enabled() else ""
+
+    # ResNet50 (reference) + deeper keras siblings (beyond the
+    # reference's five): same module, deeper stage tables, same by-name
+    # importer and knobs.  SPARKDL_RN_FUSED_SHORTCUT=1 fuses each
+    # downsample block's shortcut+reduce 1x1 convs at inference
+    # (resnet.py); off until measured on hardware.
+    for _depth, _builder in ((50, ResNet50), (101, ResNet101),
+                             (152, ResNet152)):
+        _registry.register(ModelSpec(
+            name=f"ResNet{_depth}",
+            module_builder=(lambda b=_builder:
+                            b(fused_shortcut=_rn_fused_shortcut_enabled())),
+            input_size=(224, 224), feature_size=2048,
+            preprocess_mode="caffe", keras_app=f"ResNet{_depth}",
+            variant_key_fn=_resnet_variant))
     def _xception_builder():
         # SPARKDL_XC_TILED=1 routes entry blocks 2-3 through the
         # row-tiled pallas kernel — measured -24% whole-model, so the
@@ -272,6 +289,10 @@ def _fused_heads_enabled() -> bool:
 
 def _xc_tiled_enabled() -> bool:
     return _env_flag("SPARKDL_XC_TILED", False)
+
+
+def _rn_fused_shortcut_enabled() -> bool:
+    return _env_flag("SPARKDL_RN_FUSED_SHORTCUT", False)
 
 
 def model_variant_key(name: str) -> str:

@@ -233,11 +233,13 @@ class InceptionV3(nn.Module):
                     parts.append((c0.filters, k, s, t))
                 # fold the BN scale into the kernel (conv is linear), keep
                 # the conv in the variables' dtype (bf16 under the engine)
-                kdt = parts[0][1].dtype
-                K = jnp.concatenate(
-                    [(k.astype(jnp.float32) * s).astype(kdt)
-                     for _, k, s, _ in parts], axis=-1)
-                T = jnp.concatenate([t for _, _, _, t in parts])
+                from sparkdl_tpu.models.layers import fold_bn_into_conv
+
+                folded = [fold_bn_into_conv(k, s, t)
+                          for _, k, s, t in parts]
+                kdt = folded[0][0].dtype
+                K = jnp.concatenate([f[0] for f in folded], axis=-1)
+                T = jnp.concatenate([f[1] for f in folded])
                 y = lax.conv_general_dilated(
                     x.astype(kdt), K, (1, 1), "SAME",
                     dimension_numbers=("NHWC", "HWIO", "NHWC"))

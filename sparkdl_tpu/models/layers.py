@@ -146,18 +146,39 @@ class BNAffine(nn.Module):
 
 
 class KernelParam(nn.Module):
-    """Variable-tree twin of ``nn.Conv(use_bias=False)``: declares the
-    identical ``kernel`` param (same name, shape, init) and returns it
-    instead of convolving — lets a parent fuse several branch convs into
-    one wider conv (models/inception.py fused heads) while keeping the
-    per-branch variable tree interchangeable with the plain path."""
+    """Variable-tree twin of ``nn.Conv``: declares the identical
+    ``kernel`` (and, with ``use_bias``, ``bias``) params — same names,
+    shapes, inits — and returns them instead of convolving.  Lets a
+    parent fuse several branch convs into one wider conv
+    (models/inception.py fused heads, models/resnet.py fused shortcut)
+    while keeping the per-branch variable tree interchangeable with the
+    plain path."""
 
     shape: Tuple[int, ...]
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self):
-        return self.param("kernel", nn.initializers.lecun_normal(),
-                          self.shape)
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            self.shape)
+        if not self.use_bias:
+            return kernel
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.shape[-1],))
+        return kernel, bias
+
+
+def fold_bn_into_conv(kernel, scale, shift, bias=None):
+    """Fold an inference-mode BN affine into conv constants:
+    ``(conv(x, k) + b) * s + t == conv(x, k*s) + (b*s + t)`` (conv is
+    linear).  Returns ``(K, B)`` — K cast back to the kernel's dtype so a
+    bf16 program stays bf16 (fold math in f32), B in f32 for the caller
+    to cast at the add.  Shared by every fused-conv path
+    (models/inception.py fused heads, models/resnet.py fused shortcut)
+    so precision/dtype fixes cannot diverge between them."""
+    K = (kernel.astype(jnp.float32) * scale).astype(kernel.dtype)
+    b = bias.astype(jnp.float32) if bias is not None else jnp.float32(0)
+    return K, b * scale + shift
 
 
 class DepthwiseConv2D(nn.Module):

@@ -5,11 +5,12 @@ Levers measured (results recorded in PERF.md):
   * Xception entry-flow row-tiled pallas kernel (SPARKDL_XC_TILED=1 vs 0)
   * InceptionV3 fused branch heads (SPARKDL_FUSED_HEADS=1 vs 0)
   * InceptionV3 batch sweep (128 / 256 / 512)
+  * ResNet50 fused downsample shortcut (SPARKDL_RN_FUSED_SHORTCUT=1 vs 0)
 
 Method: ``bench.measure_scan`` (steps-in-one-program, relay-artifact-free);
 models build fresh per run so the env knobs bind at build time.
 
-Run: python tools/perf_experiments.py [xception|inception|batch]...
+Run: python tools/perf_experiments.py [xception|inception|resnet|batch]...
 """
 
 from __future__ import annotations
@@ -58,6 +59,14 @@ def inception_ab(batch=128, steps=40):
                       "delta_pct": round((a / b - 1) * 100, 1)}), flush=True)
 
 
+def resnet_ab(batch=128, steps=40):
+    a = run("ResNet50", False, batch, steps, SPARKDL_RN_FUSED_SHORTCUT="1")
+    b = run("ResNet50", False, batch, steps, SPARKDL_RN_FUSED_SHORTCUT="0")
+    print(json.dumps({"experiment": "resnet_fused_shortcut",
+                      "fused": round(a, 1), "per_conv": round(b, 1),
+                      "delta_pct": round((a / b - 1) * 100, 1)}), flush=True)
+
+
 def inception_batch_sweep(steps=40):
     out = {}
     for batch in (128, 256, 512):
@@ -73,5 +82,7 @@ if __name__ == "__main__":
         xception_ab()
     if "inception" in wanted:
         inception_ab()
+    if "resnet" in wanted:
+        resnet_ab()
     if "batch" in wanted:
         inception_batch_sweep()
