@@ -124,38 +124,76 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None):
     _print_line(line)
 
 
-def measure_relay_profile():
+_RELAY_PROBE = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+prof = {}
+one = jnp.float32(1.0)
+f = jax.jit(lambda x: x + 1)
+float(f(one))  # compile
+t0 = time.perf_counter()
+for _ in range(3):
+    float(f(one))
+prof["dispatch_ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
+host = np.zeros((16, 1024, 1024), np.uint8)
+jax.device_put(host[:1]).block_until_ready()
+t0 = time.perf_counter()
+jax.device_put(host).block_until_ready()
+prof["h2d_MBps"] = round(16 / (time.perf_counter() - t0), 1)
+dev = jax.device_put(np.zeros((1024, 1024), np.uint8))
+dev.block_until_ready()
+np.asarray(dev[:1])  # absorb any first-fetch setup
+t0 = time.perf_counter()
+np.asarray(dev)
+prof["d2h_MBps"] = round(1 / (time.perf_counter() - t0), 1)
+print(json.dumps(prof))
+"""
+
+
+def measure_relay_profile(timeout_s: int = 240):
     """Per-round relay facts: H2D/D2H effective bandwidth + dispatch round
     trip.  The relay's profile has flipped between rounds (round 3: H2D
-    ~10 MB/s; round 4: H2D ~1.3 GB/s with D2H the narrow direction), so
-    env_bound annotations must not inherit stale numbers — this runs at
-    bench start and its line lands in BENCH_r*.json."""
-    import jax
-    import jax.numpy as jnp
+    ~10 MB/s; round 4: H2D ~1.3 GB/s with D2H the narrow direction; it
+    also degraded mid-session in round 5 to where a trivial jit stalled),
+    so env_bound annotations must not inherit stale numbers — this runs
+    at bench start and its line lands in BENCH_r*.json.
 
-    prof = {}
-    # dispatch+fetch round trip: trivial program, scalar result
-    one = jnp.float32(1.0)
-    f = jax.jit(lambda x: x + 1)
-    float(f(one))  # compile
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(f(one))
-    prof["dispatch_ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
-    # H2D: 16 MB uint8
-    host = np.zeros((16, 1024, 1024), np.uint8)
-    jax.device_put(host[:1]).block_until_ready()
-    t0 = time.perf_counter()
-    jax.device_put(host).block_until_ready()
-    prof["h2d_MBps"] = round(16 / (time.perf_counter() - t0), 1)
-    # D2H: 1 MB fetch (the scoring-path shape class)
-    dev = jax.device_put(np.zeros((1024, 1024), np.uint8))
-    dev.block_until_ready()
-    np.asarray(dev[:1])  # small fetch to absorb any first-fetch setup
-    t0 = time.perf_counter()
-    np.asarray(dev)
-    prof["d2h_MBps"] = round(1 / (time.perf_counter() - t0), 1)
-    return prof
+    Runs in a SUBPROCESS with a timeout: a dead/hung relay blocks inside
+    native transfer calls that Python cannot interrupt, and the bench
+    must emit an explicit unreachable-diagnostic line rather than hang
+    silently until the driver kills it."""
+    import subprocess
+    import sys
+
+    # Popen + bounded reap, not subprocess.run: run()'s post-timeout
+    # kill() is followed by an UNBOUNDED wait(), which blocks forever if
+    # the child is stuck in an uninterruptible kernel sleep (exactly the
+    # hung-native-transfer state this probe exists to detect).  A child
+    # that ignores SIGKILL for 10s is abandoned (own session, reaped by
+    # init eventually) and the timeout propagates.
+    proc = subprocess.Popen([sys.executable, "-c", _RELAY_PROBE],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # stuck in D state: abandon, don't hang the bench
+        raise
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()
+        raise RuntimeError(
+            f"relay probe failed (rc={proc.returncode}): "
+            f"{tail[-1] if tail else '<no stderr>'}")
+    lines = (out or "").strip().splitlines()
+    if not lines:
+        raise RuntimeError("relay probe produced no output")
+    return json.loads(lines[-1])
 
 
 RELAY = {}
@@ -461,9 +499,26 @@ def main():
     # mid-run, the tracked metric is already on stdout — and its line is
     # RE-EMITTED last so a parse-the-final-line driver still sees it on a
     # complete run.
+    import subprocess
+
+    relay_dead = False
     try:
         RELAY.update(measure_relay_profile())
         _print_line(json.dumps({"config": "relay", **RELAY}))
+    except subprocess.TimeoutExpired:
+        # One retry with a longer window, then declare the device
+        # unreachable: every config needs the chip, and hanging inside an
+        # uninterruptible native call until the driver kills the bench
+        # leaves no diagnostics.  Explicit skip lines beat silence.
+        try:
+            RELAY.update(measure_relay_profile(timeout_s=480))
+            _print_line(json.dumps({"config": "relay", **RELAY}))
+        except Exception as e:
+            relay_dead = True
+            _print_line(json.dumps({
+                "config": "relay",
+                "error": f"device unreachable: probe timed out twice "
+                         f"({repr(e)[:120]})"}))
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     default = "1,1e2e,2,3,4,5"
@@ -472,6 +527,12 @@ def main():
         key = key.strip()
         fn = BENCHES.get(key)
         if fn is None:
+            continue
+        if relay_dead:
+            _print_line(json.dumps({
+                "config": key,
+                "error": "skipped: device relay unreachable at bench "
+                         "start (see 'relay' line)"}))
             continue
         try:
             fn()

@@ -242,10 +242,17 @@ def _populate():
         inception_import_order)
     # Beyond the reference's five: edge/efficiency-class backbones (see
     # mobilenet.py / efficientnet.py).
+    def _mobilenet_builder():
+        # SPARKDL_MNV2_FUSED=1 routes stride-1 inverted-residual tails
+        # through the fused pallas kernel (mobilenet.py); off until
+        # measured on hardware
+        return MobileNetV2(fused_inference=_mnv2_fused_enabled())
+
     _registry.register(ModelSpec(
-        name="MobileNetV2", module_builder=MobileNetV2,
+        name="MobileNetV2", module_builder=_mobilenet_builder,
         input_size=(224, 224), feature_size=1280, preprocess_mode="tf",
-        keras_app="MobileNetV2"))
+        keras_app="MobileNetV2",
+        variant_key_fn=lambda: "fused" if _mnv2_fused_enabled() else ""))
     # The input Normalization layer is auto-named by keras ("normalization",
     # "normalization_1", ... per session build count), so it imports by
     # creation order as a fallback when the by-name match misses.
@@ -293,6 +300,10 @@ def _xc_tiled_enabled() -> bool:
 
 def _rn_fused_shortcut_enabled() -> bool:
     return _env_flag("SPARKDL_RN_FUSED_SHORTCUT", False)
+
+
+def _mnv2_fused_enabled() -> bool:
+    return _env_flag("SPARKDL_MNV2_FUSED", False)
 
 
 def model_variant_key(name: str) -> str:

@@ -156,10 +156,12 @@ class KernelParam(nn.Module):
 
     shape: Tuple[int, ...]
     use_bias: bool = False
+    # "depthwise_kernel" twins DepthwiseConv2D instead of nn.Conv
+    param_name: str = "kernel"
 
     @nn.compact
     def __call__(self):
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+        kernel = self.param(self.param_name, nn.initializers.lecun_normal(),
                             self.shape)
         if not self.use_bias:
             return kernel

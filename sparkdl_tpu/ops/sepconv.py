@@ -79,22 +79,52 @@ def unflatten(xf, h: int, w: int):
     return xf.reshape(n, rows, wp, c)[:, 1:h + 1, 1:w + 1, :]
 
 
+def halo_mask(h: int, w: int):
+    """[(H+2)*Wp, 1] f32: 1 on the interior, 0 on the halo — restores the
+    kernels' zero-halo contract after a position-wise op touches halo
+    positions outside a kernel (e.g. MobileNet's expand matmul on the
+    flat layout)."""
+    wp = flat_width(w)
+    return _interior_mask((h + 2) * wp, wp, h, w).astype(jnp.float32)
+
+
+def _dw_taps(xt, dwk_ref, wp: int):
+    """The 3x3 depthwise as 9 roll+FMA VPU passes over a padded-flat f32
+    block: ``out[q] = sum_{dy,dx} in[q + dy*wp + dx] * k[dy,dx]`` — one
+    ``pltpu.roll`` (sublane rotation) per tap.  THE layout trick of this
+    module, in one place: every kernel variant (plain, tiled, mbconv)
+    shares this loop so the delta arithmetic cannot drift."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    lo = xt.shape[0]
+    acc = jnp.zeros(xt.shape, jnp.float32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            # out[q] = in[q + dy*wp + dx]  <=>  roll by the negation
+            delta = (-(dy * wp + dx)) % lo
+            tap = pltpu.roll(xt, delta, 0) if delta else xt
+            acc += tap * dwk_ref[dy + 1, dx + 1, :].astype(jnp.float32)
+    return acc
+
+
+def _interior_mask(n_pos: int, wp: int, h: int, w: int, row0: int = 0):
+    """[n_pos, 1] bool: True on interior (non-halo, non-pad) positions of
+    a padded-flat block whose first position sits at global row ``row0``
+    — the zero-halo output contract, single-sourced for every kernel."""
+    pos = jax.lax.broadcasted_iota(jnp.int32, (n_pos, 1), 0)
+    r = row0 + pos // wp
+    col = pos % wp
+    return ((r >= 1) & (r <= h) & (col >= 1) & (col <= w))
+
+
 def _sepconv_kernel(x_ref, dwk_ref, pw_ref, scale_ref, shift_ref, out_ref,
                     *, h, w, wp, pre_relu, post_relu):
     """One batch element, whole image in padded-flat layout."""
-    from jax.experimental.pallas import tpu as pltpu
-
     lo = (h + 2) * wp
     xt = x_ref[0].astype(jnp.float32)  # Mosaic rotate needs 32-bit data
     if pre_relu:
         xt = jnp.maximum(xt, jnp.float32(0))
-    acc = jnp.zeros(xt.shape, jnp.float32)
-    for dy in (-1, 0, 1):
-        for dx in (-1, 0, 1):
-            # out[q] = in[q + dy*wp + dx]  <=>  jnp.roll by the negation
-            delta = (-(dy * wp + dx)) % lo
-            tap = pltpu.roll(xt, delta, 0) if delta else xt
-            acc += tap * dwk_ref[dy + 1, dx + 1, :].astype(jnp.float32)
+    acc = _dw_taps(xt, dwk_ref, wp)
     y = jax.lax.dot_general(
         acc.astype(jnp.bfloat16), pw_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
@@ -102,9 +132,7 @@ def _sepconv_kernel(x_ref, dwk_ref, pw_ref, scale_ref, shift_ref, out_ref,
     y = y * scale_ref[0, :] + shift_ref[0, :]
     if post_relu:
         y = jnp.maximum(y, 0.0)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (lo, 1), 0)
-    r, col = rows // wp, rows % wp
-    valid = ((r >= 1) & (r <= h) & (col >= 1) & (col <= w))
+    valid = _interior_mask(lo, wp, h, w)
     out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
 
 
@@ -161,20 +189,13 @@ def _sepconv_tiled_kernel(above_ref, cur_ref, below_ref, dwk_ref, pw_ref,
     in-bounds.  Edge tiles fetch clamped (garbage) halo blocks whose
     contributions land exclusively on masked halo/pad rows."""
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     t = pl.program_id(1)
-    lo_t = (th + 2) * wp
     xt = jnp.concatenate(
         [above_ref[0], cur_ref[0], below_ref[0]], axis=0).astype(jnp.float32)
     if pre_relu:
         xt = jnp.maximum(xt, jnp.float32(0))
-    acc = jnp.zeros(xt.shape, jnp.float32)
-    for dy in (-1, 0, 1):
-        for dx in (-1, 0, 1):
-            delta = (-(dy * wp + dx)) % lo_t
-            tap = pltpu.roll(xt, delta, 0) if delta else xt
-            acc += tap * dwk_ref[dy + 1, dx + 1, :].astype(jnp.float32)
+    acc = _dw_taps(xt, dwk_ref, wp)
     y = jax.lax.dot_general(
         acc[wp:wp + th * wp].astype(jnp.bfloat16), pw_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
@@ -182,10 +203,7 @@ def _sepconv_tiled_kernel(above_ref, cur_ref, below_ref, dwk_ref, pw_ref,
     y = y * scale_ref[0, :] + shift_ref[0, :]
     if post_relu:
         y = jnp.maximum(y, 0.0)
-    local = jax.lax.broadcasted_iota(jnp.int32, (th * wp, 1), 0)
-    r = t * th + local // wp
-    col = local % wp
-    valid = ((r >= 1) & (r <= h) & (col >= 1) & (col <= w))
+    valid = _interior_mask(th * wp, wp, h, w, row0=t * th)
     out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
 
 
@@ -243,6 +261,104 @@ def _fused_sepconv_tpu_tiled(xf, dwk, pw, scale, shift, h, w, th, pre_relu,
       pw.astype(jnp.bfloat16),
       scale.reshape(1, f).astype(jnp.float32),
       shift.reshape(1, f).astype(jnp.float32))
+
+
+def _mbconv_kernel(x_ref, dwk_ref, pw_ref, mid_shift_ref, shift_ref,
+                   out_ref, *, h, w, wp):
+    """One batch element of the MobileNet inverted-residual tail:
+    ``BN(project(relu6(BN(depthwise(x)))))`` with both BN scales already
+    FOLDED into ``dwk``/``pw`` by the caller (depthwise and 1x1 convs are
+    per-output-channel linear), leaving one mid shift + relu6 clamp
+    between the stages and one output shift after the dot."""
+    lo = (h + 2) * wp
+    xt = x_ref[0].astype(jnp.float32)
+    acc = _dw_taps(xt, dwk_ref, wp)
+    acc = jnp.clip(acc + mid_shift_ref[0, :], 0.0, 6.0)  # BN shift + relu6
+    y = jax.lax.dot_general(
+        acc.astype(jnp.bfloat16), pw_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = y + shift_ref[0, :]  # project BN (scale folded into pw)
+    valid = _interior_mask(lo, wp, h, w)
+    out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "w", "interpret"))
+def _fused_mbconv_tpu(xf, dwk, pw, mid_shift, shift, h, w,
+                      interpret=False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, lo, c = xf.shape
+    f = pw.shape[-1]
+    wp = flat_width(w)
+    assert lo == (h + 2) * wp, (lo, h, w, wp)
+    kernel = functools.partial(_mbconv_kernel, h=h, w=w, wp=wp)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, lo, c), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, c), lambda b: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, f), lambda b: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda b: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f), lambda b: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, lo, f), lambda b: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, lo, f), jnp.bfloat16),
+        interpret=interpret,
+    )(xf.astype(jnp.bfloat16), dwk.astype(jnp.bfloat16),
+      pw.astype(jnp.bfloat16),
+      mid_shift.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, f).astype(jnp.float32))
+
+
+def mbconv_reference(x, dwk, pw, mid_shift, shift):
+    """Pure-jax twin of the mbconv kernel in NHWC (parity oracle /
+    non-TPU fallback), on the same FOLDED weights: depthwise 3x3 SAME ->
+    +mid_shift -> relu6 -> 1x1 conv -> +shift."""
+    cdt = jnp.bfloat16
+    c = x.shape[-1]
+    y = jax.lax.conv_general_dilated(
+        x.astype(cdt), dwk.reshape(3, 3, 1, c).astype(cdt),
+        window_strides=(1, 1), padding="SAME", feature_group_count=c,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    y = jnp.clip(y + mid_shift, 0.0, 6.0)
+    y = jax.lax.conv_general_dilated(
+        y.astype(cdt), pw.reshape(1, 1, c, -1).astype(cdt),
+        window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return (y + shift).astype(cdt)
+
+
+def fused_mbconv_flat(xf, dwk, pw, mid_shift, shift, h: int, w: int,
+                      force: Optional[bool] = None):
+    """Fused MobileNet inverted-residual tail on PADDED-FLAT input/output
+    (zero-halo contract as :func:`fused_sepconv_flat`).  ``dwk``
+    [3,3,C]/[3,3,C,1] and ``pw`` [C,F]/[1,1,C,F] must already carry their
+    BN scales (``models.layers.fold_bn_into_conv``); ``mid_shift`` [C] is
+    the depthwise BN shift (applied before the relu6 clamp), ``shift``
+    [F] the project BN shift (linear bottleneck: no output activation).
+    """
+    if dwk.ndim == 4:
+        dwk = dwk.reshape(3, 3, -1)
+    if pw.ndim == 4:
+        pw = pw.reshape(pw.shape[-2], pw.shape[-1])
+    use_pallas = _on_tpu() if force is None else force
+    if use_pallas:
+        return _fused_mbconv_tpu(xf, dwk, pw, mid_shift, shift, h, w,
+                                 interpret=(force == "interpret"))
+    x = unflatten(xf, h, w)
+    y = mbconv_reference(x, dwk, pw, mid_shift, shift)
+    return pad_to_flat(y, h, w)
 
 
 def sepconv_reference(x, dwk, pw, scale, shift, pre_relu: bool,

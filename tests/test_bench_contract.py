@@ -1,0 +1,77 @@
+"""bench.py output-contract tests (hardware-free).
+
+The driver parses bench stdout line by line and keeps the FINAL line as
+the tracked metric, so the JSON-line contract — self-describing
+denominators, the two-sided baseline fields, and the explicit
+dead-relay diagnostics — is product surface and gets pinned here; the
+actual throughput numbers need the chip and are the driver's job.
+"""
+
+import json
+import subprocess
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def captured(monkeypatch):
+    lines = []
+    monkeypatch.setattr(bench, "_print_line",
+                        lambda s: lines.append(json.loads(s)))
+    monkeypatch.setattr(bench, "_LINES", {})
+    return lines
+
+
+def test_emit_two_sided_baseline_fields(captured):
+    """FLOP-scaled lines carry BOTH vs_baseline (per-model denominator)
+    and vs_sourced_anchor (value / the single sourced 875) so the
+    denominator-method sensitivity is visible in the JSON itself
+    (VERDICT r4 #4)."""
+    bench.emit("2-Xception", "m", 3184.0, "images/sec/chip",
+               baseline_model="Xception")
+    rec = captured[-1]
+    assert rec["vs_baseline"] == pytest.approx(3184 / 573, rel=0.01)
+    assert rec["vs_sourced_anchor"] == pytest.approx(3184 / 875, rel=0.01)
+    # the sourced anchor itself carries only vs_baseline (same number)
+    bench.emit("1", "m", 6500.0, "images/sec/chip",
+               baseline_model="InceptionV3")
+    rec = captured[-1]
+    assert rec["vs_baseline"] == pytest.approx(6500 / 875, rel=0.01)
+    assert "vs_sourced_anchor" not in rec
+
+
+def test_denominators_cover_reference_zoo():
+    """Every reference SUPPORTED_MODELS member has a defensible
+    denominator; beyond-reference models report null."""
+    for name in ("InceptionV3", "ResNet50", "VGG16", "VGG19", "Xception"):
+        ips, basis = bench.v100_baseline(name)
+        assert ips and basis, name
+    for name in ("MobileNetV2", "EfficientNetB0", "ResNet101", "ResNet152"):
+        assert bench.v100_baseline(name) == (None, None), name
+
+
+def test_dead_relay_emits_skip_lines(captured, monkeypatch):
+    """A dead relay must produce explicit diagnostic lines, not a silent
+    hang inside uninterruptible native transfer calls."""
+    def dead_probe(timeout_s=240):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout_s)
+
+    monkeypatch.setattr(bench, "measure_relay_profile", dead_probe)
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1,3")
+    monkeypatch.setattr(bench, "RELAY", {})
+    bench.main()
+    assert captured[0]["config"] == "relay"
+    assert "unreachable" in captured[0]["error"]
+    assert [r["config"] for r in captured[1:]] == ["1", "3"]
+    assert all("skipped" in r["error"] for r in captured[1:])
+
+
+def test_relay_tag_formats_measured_profile(monkeypatch):
+    monkeypatch.setattr(bench, "RELAY", {})
+    assert "unmeasured" in bench._relay_tag()
+    bench.RELAY.update({"dispatch_ms": 108.5, "h2d_MBps": 34.0,
+                        "d2h_MBps": 4.1})
+    tag = bench._relay_tag()
+    assert "108.5" in tag and "34.0" in tag and "4.1" in tag

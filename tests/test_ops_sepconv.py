@@ -163,6 +163,67 @@ def test_tiled_kernel_chain_interpreted(rng):
                                rtol=0.1, atol=0.08)
 
 
+@pytest.mark.parametrize("h,w,c,f", [(13, 11, 16, 24), (14, 14, 48, 32)])
+def test_mbconv_kernel_parity_interpreted(rng, h, w, c, f):
+    """The fused MobileNet inverted-residual tail kernel (depthwise ->
+    +BN-shift -> relu6 -> 1x1 project -> +BN-shift, scales pre-folded)
+    == the jax reference, incl. the zero-halo output contract."""
+    from sparkdl_tpu.ops.sepconv import fused_mbconv_flat
+
+    x = jnp.asarray(rng.normal(size=(2, h, w, c)), jnp.float32)
+    dwk = jnp.asarray(rng.normal(0, 0.3, (3, 3, c)), jnp.float32)
+    pw = jnp.asarray(rng.normal(0, 0.1, (c, f)), jnp.float32)
+    mid = jnp.asarray(rng.normal(0, 0.5, (c,)), jnp.float32)
+    sh = jnp.asarray(rng.normal(0, 0.2, (f,)), jnp.float32)
+    xf = pad_to_flat(x, h, w)
+    got_f = fused_mbconv_flat(xf, dwk, pw, mid, sh, h, w,
+                              force="interpret")
+    ref_f = fused_mbconv_flat(xf, dwk, pw, mid, sh, h, w, force=False)
+    got = np.asarray(unflatten(got_f, h, w), np.float32)
+    ref = np.asarray(unflatten(ref_f, h, w), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.05)
+    wp = flat_width(w)
+    grid = np.asarray(got_f, np.float32).reshape(2, h + 2, wp, f)
+    assert np.all(grid[:, 0] == 0) and np.all(grid[:, -1] == 0)
+    assert np.all(grid[:, :, 0] == 0) and np.all(grid[:, :, w + 1:] == 0)
+
+
+def test_mobilenet_fused_matches_unfused(rng, monkeypatch):
+    """Model-level parity for MobileNetV2(fused_inference=True): the
+    flat-stage chaining (masked expand matmul + fused tail + residuals in
+    flat layout) matches the plain module from the same variables, with
+    an identical variable tree; the registry env knob gates and keys
+    the variant."""
+    import jax
+
+    from sparkdl_tpu.models import get_model_spec, model_variant_key
+    from sparkdl_tpu.models.mobilenet import MobileNetV2
+
+    x = jnp.asarray(rng.random((2, 96, 96, 3)) * 2 - 1, jnp.float32)
+    m0 = MobileNetV2(num_classes=5, fused_inference=False)
+    m1 = MobileNetV2(num_classes=5, fused_inference=True)
+    v0 = m0.init(jax.random.PRNGKey(0), x, train=False)
+    v1 = jax.eval_shape(lambda: m1.init(jax.random.PRNGKey(0), x,
+                                        train=False))
+    assert (jax.tree_util.tree_structure(v0)
+            == jax.tree_util.tree_structure(v1))
+    a = np.asarray(m0.apply(v0, x, train=False, features=True))
+    b = np.asarray(m1.apply(v0, x, train=False, features=True))
+    np.testing.assert_allclose(b, a, rtol=0.05, atol=0.02)
+    # train mode takes the plain branch (BN needs batch stats)
+    out, mut = m1.apply(v0, x, train=True, features=True,
+                        mutable=["batch_stats"])
+    assert "batch_stats" in mut
+
+    spec = get_model_spec("MobileNetV2")
+    monkeypatch.delenv("SPARKDL_MNV2_FUSED", raising=False)
+    assert spec.build().fused_inference is False  # off until measured
+    assert model_variant_key("MobileNetV2") == ""
+    monkeypatch.setenv("SPARKDL_MNV2_FUSED", "1")
+    assert spec.build().fused_inference is True
+    assert model_variant_key("MobileNetV2") == "fused"
+
+
 def test_xception_tiled_entry_wiring(rng, monkeypatch):
     """Model-level wiring of the row-tiled entry path: with
     ``tiled_entry=True`` the entry blocks route through

@@ -6,11 +6,12 @@ Levers measured (results recorded in PERF.md):
   * InceptionV3 fused branch heads (SPARKDL_FUSED_HEADS=1 vs 0)
   * InceptionV3 batch sweep (128 / 256 / 512)
   * ResNet50 fused downsample shortcut (SPARKDL_RN_FUSED_SHORTCUT=1 vs 0)
+  * MobileNetV2 fused inverted-residual tail (SPARKDL_MNV2_FUSED=1 vs 0)
 
 Method: ``bench.measure_scan`` (steps-in-one-program, relay-artifact-free);
 models build fresh per run so the env knobs bind at build time.
 
-Run: python tools/perf_experiments.py [xception|inception|resnet|batch]...
+Run: python tools/perf_experiments.py [xception|inception|resnet|mobilenet|batch]...
 """
 
 from __future__ import annotations
@@ -67,6 +68,14 @@ def resnet_ab(batch=128, steps=40):
                       "delta_pct": round((a / b - 1) * 100, 1)}), flush=True)
 
 
+def mobilenet_ab(batch=256, steps=40):
+    a = run("MobileNetV2", False, batch, steps, SPARKDL_MNV2_FUSED="1")
+    b = run("MobileNetV2", False, batch, steps, SPARKDL_MNV2_FUSED="0")
+    print(json.dumps({"experiment": "mobilenet_fused_tail",
+                      "fused": round(a, 1), "xla": round(b, 1),
+                      "delta_pct": round((a / b - 1) * 100, 1)}), flush=True)
+
+
 def inception_batch_sweep(steps=40):
     out = {}
     for batch in (128, 256, 512):
@@ -84,5 +93,7 @@ if __name__ == "__main__":
         inception_ab()
     if "resnet" in wanted:
         resnet_ab()
+    if "mobilenet" in wanted:
+        mobilenet_ab()
     if "batch" in wanted:
         inception_batch_sweep()
