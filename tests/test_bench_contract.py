@@ -68,6 +68,31 @@ def test_dead_relay_emits_skip_lines(captured, monkeypatch):
     assert all("skipped" in r["error"] for r in captured[1:])
 
 
+def test_retry_nontimeout_failure_does_not_skip_configs(captured,
+                                                        monkeypatch):
+    """A transient first-probe timeout followed by a fast non-timeout
+    retry failure means the device answered: diagnostics only, configs
+    still run (the first-attempt 'profile failure must not block the
+    bench' policy)."""
+    calls = {"n": 0}
+
+    def probe(timeout_s=240):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise subprocess.TimeoutExpired(cmd="p", timeout=timeout_s)
+        raise RuntimeError("fast rc=1 failure")
+
+    monkeypatch.setattr(bench, "measure_relay_profile", probe)
+    monkeypatch.setattr(bench, "RELAY", {})
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "1", lambda: ran.append("1"))
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1")
+    bench.main()
+    assert ran == ["1"]                       # attempted, not skipped
+    assert "RuntimeError" in captured[0]["error"]
+    assert not any("skipped" in (r.get("error") or "") for r in captured)
+
+
 def test_relay_tag_formats_measured_profile(monkeypatch):
     monkeypatch.setattr(bench, "RELAY", {})
     assert "unmeasured" in bench._relay_tag()
