@@ -10,19 +10,36 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.slow
-def test_migration_example_runs(tmp_path):
+def _run_example(script: str, marker: str, cwd, extra_env=None,
+                 pop_env=()):
+    """Shared runner: one place owns the subprocess contract (cwd
+    isolation, timeout, stderr truncation, marker assert)."""
     env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-        "PYTHONPATH": REPO,
-        "TF_CPP_MIN_LOG_LEVEL": "2",
-    })
+    env.update({"PYTHONPATH": REPO, "TF_CPP_MIN_LOG_LEVEL": "2"})
+    env.update(extra_env or {})
+    for k in pop_env:
+        env.pop(k, None)
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples",
-                                      "migrate_from_sparkdl.py")],
-        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        env=env, cwd=str(cwd), capture_output=True, text=True,
         timeout=600)
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert '{"migration_smoke": "ok"}' in proc.stdout
+    assert marker in proc.stdout
+
+
+@pytest.mark.slow
+def test_migration_example_runs(tmp_path):
+    _run_example(
+        "migrate_from_sparkdl.py", '{"migration_smoke": "ok"}', tmp_path,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+
+
+@pytest.mark.slow
+def test_distributed_fit_example_runs(tmp_path):
+    """The multi-controller training example (2 processes x 2 virtual
+    devices, dp=4, vs a single-controller oracle) is the topology
+    envelope's executable documentation — keep it green."""
+    _run_example("distributed_fit.py", '"distributed_fit": "ok"',
+                 tmp_path,
+                 pop_env=("XLA_FLAGS",))  # example provisions devices
