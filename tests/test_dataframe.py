@@ -212,6 +212,61 @@ def test_map_rows_struct_view_survives_arrow_rebuild():
     assert all(r["origin"] == "r0" for r in rows)
 
 
+def test_map_rows_fuzz_against_old_path_semantics():
+    """Seeded fuzz over one image-bearing schema: random data, null
+    positions, chunkings, and a mix of passthrough/modify/rename fns —
+    the zero-copy rewrite must reproduce the old to_pylist+from_pylist
+    path's values row for row, bit-exactly."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
+
+    rng = np.random.default_rng(1234)
+
+    def old_path(table, fn, batch_size):
+        out = []
+        for rb in table.to_batches(max_chunksize=batch_size):
+            out.extend(fn(dict(r)) for r in rb.to_pylist())
+        return out
+
+    def norm(v):
+        if isinstance(v, dict):
+            return {k: norm(x) for k, x in v.items()}
+        if isinstance(v, memoryview):
+            return bytes(v)
+        return v  # floats compare EXACTLY: both paths must be bit-identical
+
+    for trial in range(8):
+        n = int(rng.integers(3, 12))
+        null_at = int(rng.integers(0, n)) if trial % 2 else None
+        structs = [imageArrayToStruct(
+            (rng.random((4, 5, 3)) * 255).astype(np.uint8),
+            origin=f"t{trial}r{i}") for i in range(n)]
+        if null_at is not None:
+            structs[null_at] = None
+        tbl = pa.table({
+            "image": pa.array(structs, type=imageSchema),
+            "k": [int(v) for v in rng.integers(0, 100, n)],
+            "s": [f"s{v}" for v in rng.integers(0, 9, n)],
+            "f": [float(v) for v in rng.random(n)],
+        })
+        fns = [
+            lambda r: {"image": r["image"], "k2": r["k"] * 2},     # pass
+            lambda r: {"img2": r["image"], "s": r["s"]},           # rename
+            lambda r: {"image": (dict(r["image"], origin="X")      # modify
+                                 if r["image"] is not None else None),
+                       "f": r["f"] + 0.5},
+        ]
+        fn = fns[trial % 3]
+        bs = int(rng.integers(2, n + 2))
+        got = [ {k: norm(v) for k, v in r.items()}
+                for r in DataFrame(tbl).map_rows(fn, batch_size=bs)
+                .table.to_pylist()]
+        want = [{k: norm(v) for k, v in fn_out.items()}
+                for fn_out in old_path(tbl, lambda r: dict(fn(r)), bs)]
+        assert got == want, (trial, bs, got[:2], want[:2])
+
+
 def test_map_blocks_columnar():
     """Block-wise map (TensorFrames map_blocks parity): fn sees record
     batches, never per-row Python objects, and may change the layout."""
