@@ -217,8 +217,6 @@ def test_map_rows_fuzz_against_old_path_semantics():
     positions, chunkings, and a mix of passthrough/modify/rename fns —
     the zero-copy rewrite must reproduce the old to_pylist+from_pylist
     path's values row for row, bit-exactly."""
-    import pyarrow as pa
-
     from sparkdl_tpu.image.schema import imageArrayToStruct, imageSchema
 
     rng = np.random.default_rng(1234)
@@ -263,7 +261,7 @@ def test_map_rows_fuzz_against_old_path_semantics():
                 for r in DataFrame(tbl).map_rows(fn, batch_size=bs)
                 .table.to_pylist()]
         want = [{k: norm(v) for k, v in fn_out.items()}
-                for fn_out in old_path(tbl, lambda r: dict(fn(r)), bs)]
+                for fn_out in old_path(tbl, fn, bs)]
         assert got == want, (trial, bs, got[:2], want[:2])
 
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Run every hardware A/B that round 5's relay outage left pending, in
+# priority order, each with its own timeout so one hung experiment
+# cannot eat the window.  Appends all JSON lines to
+# artifacts/perf_r05/experiments.jsonl (the committed measurement
+# record) and drops raw logs next to it.
+#
+# Usage: bash tools/run_pending_abs.sh        (needs the TPU reachable)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT=artifacts/perf_r05
+mkdir -p "$OUT"
+
+run() {  # run <tag> <timeout_s> <cmd...>
+  local tag=$1 t=$2; shift 2
+  echo "=== $tag ==="
+  timeout "$t" "$@" > "$OUT/$tag.log" 2>&1
+  local rc=$?
+  grep -hE '^\{' "$OUT/$tag.log" | tee -a "$OUT/experiments.jsonl"
+  [ $rc -ne 0 ] && echo "{\"experiment\": \"$tag\", \"error\": \"rc=$rc (timeout or failure; see $OUT/$tag.log)\"}" \
+      | tee -a "$OUT/experiments.jsonl"
+  return 0
+}
+
+# 1. quick probe first: abort early if the relay is still dead
+timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda x: x+1)(jnp.float32(1))))" \
+  || { echo "{\"experiment\": \"pending_abs\", \"error\": \"relay unreachable; nothing run\", \"ts\": \"$(date -Is)\"}" \
+       | tee -a "$OUT/experiments.jsonl"; exit 0; }
+
+run resnet_fused_shortcut   900 python tools/perf_experiments.py resnet
+run mobilenet_fused_tail    900 python tools/perf_experiments.py mobilenet
+# batches_per_dispatch on the dispatch-bound configs: A/B via env
+run bpd4_configs34          900 env SPARKDL_BATCHES_PER_DISPATCH=4 SPARKDL_BENCH_CONFIGS=3,4 python bench.py
+run bpd1_configs34          900 env SPARKDL_BENCH_CONFIGS=3,4 python bench.py
+# fresh fused-heads profile artifact
+run profile_inception       600 python tools/capture_profile.py InceptionV3 artifacts/profile_r05 128
+echo "done — review $OUT/experiments.jsonl, update PERF.md, and flip any lever that clearly wins"
