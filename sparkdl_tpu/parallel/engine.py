@@ -106,7 +106,28 @@ class InferenceEngine:
                  metrics: Optional[Metrics] = None):
         import jax
 
-        self.mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+        # Scoring is per-controller by design (PERF.md topology
+        # envelope): each host scores its own rows on its own devices.
+        # Under multi-controller jax the default mesh therefore covers
+        # LOCAL devices only (the zoo transformers pass no mesh, so this
+        # keeps them working on pods), and an EXPLICIT cross-process
+        # mesh is refused loudly — device_put of process-local numpy
+        # onto a global sharding fails confusingly at runtime.
+        if mesh is not None:
+            self.mesh = mesh
+        elif jax.process_count() > 1:
+            self.mesh = mesh_lib.get_mesh(devices=jax.local_devices())
+        else:
+            self.mesh = mesh_lib.get_mesh()
+        if jax.process_count() > 1 and any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat):
+            raise NotImplementedError(
+                "InferenceEngine is single-controller: pass a mesh over "
+                "this process's local devices (mesh.get_mesh(devices="
+                "jax.local_devices())) and shard input rows per host; "
+                "multi-controller collectives belong to the TRAIN path "
+                "(parallel.train / parallel.distributed).")
         self.data_parallel = self.mesh.shape[mesh_lib.DATA_AXIS]
         # Round the device batch up to a multiple of the data-axis size so
         # every chip gets identical work.

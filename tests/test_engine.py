@@ -150,6 +150,31 @@ def test_engine_batches_per_dispatch_pytree(setup):
     assert b["ids"].dtype.kind in "iu"  # never floated
 
 
+def test_engine_multicontroller_mesh_policy(setup, monkeypatch):
+    """Scoring is per-controller: under multi-controller jax the DEFAULT
+    mesh covers local devices only (the zoo transformers pass no mesh,
+    so they keep working on pods), while an EXPLICIT mesh spanning other
+    processes is refused loudly at construction (device_put of
+    process-local numpy onto a global sharding fails confusingly at
+    runtime otherwise)."""
+    import jax
+
+    from sparkdl_tpu.parallel import mesh as mesh_lib
+
+    variables, x, ref = setup
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # default mesh: local devices, scoring still works end to end
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    assert all(d.process_index == jax.process_index()
+               for d in eng.mesh.devices.flat)
+    np.testing.assert_allclose(eng(x), ref, rtol=1e-5, atol=1e-6)
+    # explicit cross-process mesh: refused
+    remote = mesh_lib.get_mesh()
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    with pytest.raises(NotImplementedError, match="single-controller"):
+        InferenceEngine(_fn, variables, device_batch_size=8, mesh=remote)
+
+
 def test_engine_empty_input_rejected(setup):
     variables, x, _ = setup
     eng = InferenceEngine(_fn, variables, device_batch_size=8)
