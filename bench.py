@@ -34,10 +34,18 @@ whose measured value is capped by THIS sandbox (slow/asymmetric relay
 transfers — D2H ~1-6 MB/s, ~120 ms dispatch round trip — and the 1-vCPU
 host; PERF.md) carry a self-describing ``env_bound`` marker.
 
-Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default "1,1e2e,2,3,4,5" —
-headline first so a timed-out run still printed it; it is re-emitted last
-on completion), SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20),
-SPARKDL_BENCH_DTYPE (bfloat16|float32).
+Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default
+"1,1e2e,2,3,4,5,serving" — headline first so a timed-out run still
+printed it; it is re-emitted last on completion), SPARKDL_BENCH_BATCH
+(128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE (bfloat16|float32),
+SPARKDL_BENCH_SERVING_REQUESTS (512).
+
+The "serving" config measures the online layer (sparkdl_tpu.serving):
+dynamic-batching throughput plus p50/p99 request latency on a synthetic
+model, in a subprocess; when the relay probe declares the device
+unreachable it is the ONE config that still runs, pinned to host CPU
+(the serving envelope is host orchestration + XLA compute, so the
+fallback still exercises the whole stack end-to-end).
 """
 
 from __future__ import annotations
@@ -98,14 +106,17 @@ def _print_line(line):
     print(line, flush=True)
 
 
-def emit(config, metric, value, unit, baseline_model=None, env_bound=None):
+def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
+         extra=None):
     """One self-describing JSON line.  ``baseline_model`` resolves the
     per-model denominator (vs_baseline = value / denominator); lines with
     no defensible denominator emit vs_baseline null.  FLOP-scaled lines
     also carry ``vs_sourced_anchor`` (value / the single sourced 875
     anchor) so the denominator-method sensitivity is visible in the JSON
     itself, not only in BASELINE.md prose.  ``env_bound`` marks values
-    capped by this sandbox rather than the framework (PERF.md)."""
+    capped by this sandbox rather than the framework (PERF.md).  ``extra``
+    merges additional self-describing fields into the record (e.g. the
+    serving config's p50/p99 latency) without touching the core keys."""
     denom, basis = v100_baseline(baseline_model) if baseline_model else (
         None, None)
     rec = {
@@ -119,6 +130,11 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None):
     }
     if basis is not None and basis.startswith("flop-scaled"):
         rec["vs_sourced_anchor"] = round(float(value) / V100_BASELINE_IPS, 3)
+    for k, v in (extra or {}).items():
+        if k in rec:  # extra merges, never shadows, the contract keys
+            raise ValueError(f"emit extra field {k!r} collides with a "
+                             f"core contract key")
+        rec[k] = v
     line = json.dumps(rec)
     _LINES[config] = line
     _print_line(line)
@@ -151,30 +167,21 @@ print(json.dumps(prof))
 """
 
 
-def measure_relay_profile(timeout_s: int = 240):
-    """Per-round relay facts: H2D/D2H effective bandwidth + dispatch round
-    trip.  The relay's profile has flipped between rounds (round 3: H2D
-    ~10 MB/s; round 4: H2D ~1.3 GB/s with D2H the narrow direction; it
-    also degraded mid-session in round 5 to where a trivial jit stalled),
-    so env_bound annotations must not inherit stale numbers — this runs
-    at bench start and its line lands in BENCH_r*.json.
+def _run_json_subprocess(code: str, timeout_s: int, env=None):
+    """Run ``code`` in a child Python; parse its LAST stdout line as JSON.
 
-    Runs in a SUBPROCESS with a timeout: a dead/hung relay blocks inside
-    native transfer calls that Python cannot interrupt, and the bench
-    must emit an explicit unreachable-diagnostic line rather than hang
-    silently until the driver kills it."""
+    Popen + bounded reap, not subprocess.run: run()'s post-timeout
+    kill() is followed by an UNBOUNDED wait(), which blocks forever if
+    the child is stuck in an uninterruptible kernel sleep (exactly the
+    hung-native-transfer state the relay probe exists to detect).  A
+    child that ignores SIGKILL for 10s is abandoned (own session, reaped
+    by init eventually) and the timeout propagates."""
     import subprocess
     import sys
 
-    # Popen + bounded reap, not subprocess.run: run()'s post-timeout
-    # kill() is followed by an UNBOUNDED wait(), which blocks forever if
-    # the child is stuck in an uninterruptible kernel sleep (exactly the
-    # hung-native-transfer state this probe exists to detect).  A child
-    # that ignores SIGKILL for 10s is abandoned (own session, reaped by
-    # init eventually) and the timeout propagates.
-    proc = subprocess.Popen([sys.executable, "-c", _RELAY_PROBE],
+    proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True,
+                            stderr=subprocess.PIPE, text=True, env=env,
                             start_new_session=True)
     try:
         out, err = proc.communicate(timeout=timeout_s)
@@ -188,12 +195,27 @@ def measure_relay_profile(timeout_s: int = 240):
     if proc.returncode != 0:
         tail = (err or "").strip().splitlines()
         raise RuntimeError(
-            f"relay probe failed (rc={proc.returncode}): "
+            f"bench subprocess failed (rc={proc.returncode}): "
             f"{tail[-1] if tail else '<no stderr>'}")
     lines = (out or "").strip().splitlines()
     if not lines:
-        raise RuntimeError("relay probe produced no output")
+        raise RuntimeError("bench subprocess produced no output")
     return json.loads(lines[-1])
+
+
+def measure_relay_profile(timeout_s: int = 240):
+    """Per-round relay facts: H2D/D2H effective bandwidth + dispatch round
+    trip.  The relay's profile has flipped between rounds (round 3: H2D
+    ~10 MB/s; round 4: H2D ~1.3 GB/s with D2H the narrow direction; it
+    also degraded mid-session in round 5 to where a trivial jit stalled),
+    so env_bound annotations must not inherit stale numbers — this runs
+    at bench start and its line lands in BENCH_r*.json.
+
+    Runs in a SUBPROCESS with a timeout: a dead/hung relay blocks inside
+    native transfer calls that Python cannot interrupt, and the bench
+    must emit an explicit unreachable-diagnostic line rather than hang
+    silently until the driver kills it."""
+    return _run_json_subprocess(_RELAY_PROBE, timeout_s)
 
 
 RELAY = {}
@@ -484,6 +506,85 @@ def bench_config5():
          env_bound=_relay_tag() + "-per-step+1vcpu-host (PERF.md)")
 
 
+# Serving bench child: the online path end-to-end (admission -> dynamic
+# micro-batching -> bucketed engine dispatch -> future demux) on a small
+# synthetic image model.  Runs in a SUBPROCESS so the parent can pin
+# JAX_PLATFORMS=cpu when the relay is dead — the serving layer is host
+# orchestration + XLA compute, so the CPU fallback still measures the
+# framework (queueing/batching) envelope and keeps the line alive.
+_SERVING_BENCH = r"""
+import json, os, time
+import numpy as np
+from sparkdl_tpu.serving import Server
+
+rng = np.random.default_rng(0)
+w = rng.normal(0, 0.05, (32 * 32 * 3, 64)).astype(np.float32)
+
+def fn(v, x):
+    import jax.numpy as jnp
+    xf = jnp.asarray(x, jnp.float32).reshape((x.shape[0], -1)) / 255.0
+    return jnp.tanh(xf @ v["w"])
+
+n = int(os.environ.get("SPARKDL_BENCH_SERVING_REQUESTS", "512"))
+x = (rng.random((n, 32, 32, 3)) * 255).astype(np.uint8)
+srv = Server(fn, {"w": w}, max_batch_size=64, max_wait_ms=2.0,
+             max_queue=n + 64)
+srv.warmup(x[0])  # compile every bucket before timing
+t0 = time.perf_counter()
+futs = [srv.submit(x[i]) for i in range(n)]
+for f in futs:
+    f.result()
+elapsed = time.perf_counter() - t0
+m = srv.metrics
+fill = m.histograms.get("serving.batch_fill_ratio", [])
+out = {
+    "ips": n / elapsed,
+    "p50_ms": 1e3 * m.percentile("serving.request_latency", 50),
+    "p99_ms": 1e3 * m.percentile("serving.request_latency", 99),
+    "batch_fill_ratio": (sum(fill) / len(fill)) if fill else None,
+    "num_requests": n,
+    "num_batches": int(m.counters.get("serving.batches", 0)),
+}
+srv.close()
+print(json.dumps(out))
+"""
+
+
+_RELAY_DEAD = [False]
+
+
+def bench_serving():
+    """Online serving: dynamic-batching throughput + p50/p99 latency on
+    the synthetic model; falls back to host CPU when the relay is dead
+    (the one config that must survive a dead chip — it measures the
+    serving envelope, not the accelerator)."""
+    cpu_fallback = bool(_RELAY_DEAD[0])
+    env = dict(os.environ)
+    if cpu_fallback:
+        env["JAX_PLATFORMS"] = "cpu"
+    prof = _run_json_subprocess(_SERVING_BENCH, timeout_s=480, env=env)
+    if cpu_fallback:
+        bound = ("cpu-fallback: relay unreachable at bench start; serving "
+                 "stack (queue/batching/dispatch) exercised end-to-end on "
+                 "host CPU")
+    else:
+        bound = _relay_tag() + ("-per-batch+1vcpu-host (per-request "
+                                "latency includes the relay dispatch "
+                                "round trip)")
+    emit("serving",
+         "async dynamic-batching serving throughput (synthetic model)",
+         prof["ips"], "images/sec",
+         env_bound=bound,
+         extra={
+             "p50_ms": round(float(prof["p50_ms"]), 2),
+             "p99_ms": round(float(prof["p99_ms"]), 2),
+             "batch_fill_ratio": (round(float(prof["batch_fill_ratio"]), 3)
+                                  if prof.get("batch_fill_ratio") is not None
+                                  else None),
+             "num_requests": prof["num_requests"],
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -491,6 +592,7 @@ BENCHES = {
     "3": bench_config3,
     "4": bench_config4,
     "5": bench_config5,
+    "serving": bench_serving,
 }
 
 
@@ -526,14 +628,17 @@ def main():
                                     "error": repr(e)[:200]}))
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
-    default = "1,1e2e,2,3,4,5"
+    _RELAY_DEAD[0] = relay_dead
+    default = "1,1e2e,2,3,4,5,serving"
     wanted = os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")
     for key in wanted:
         key = key.strip()
         fn = BENCHES.get(key)
         if fn is None:
             continue
-        if relay_dead:
+        if relay_dead and key != "serving":
+            # "serving" still runs on its CPU fallback: it measures the
+            # serving envelope (queue/batching/dispatch), not the chip.
             _print_line(json.dumps({
                 "config": key,
                 "error": "skipped: device relay unreachable at bench "

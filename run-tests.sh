@@ -5,7 +5,17 @@
 # XLA_FLAGS/JAX_PLATFORMS); exits non-zero on any failure. Run this before
 # every snapshot/commit of substance — a red suite must never ship.
 #
+# Tier-1 (the driver's gate) is `-m 'not slow'` over tests/: the serving
+# suite (tests/test_serving.py) is CPU-only and carries no slow marks, so
+# the online path sits inside the tier-1 gate by construction — the check
+# below keeps that wiring from silently regressing if the file moves.
+#
 # Usage: ./run-tests.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")"
+if [[ ! -f tests/test_serving.py ]]; then
+  echo "FATAL: tests/test_serving.py missing — the serving subsystem" \
+       "would ship untested" >&2
+  exit 1
+fi
 exec python -m pytest tests/ -q --durations=10 "$@"

@@ -45,6 +45,9 @@ _LAZY = {
     # udf
     "registerKerasImageUDF": "sparkdl_tpu.udf",
     "register_image_udf": "sparkdl_tpu.udf",
+    # serving (online inference layer; "serving" exposes the module itself)
+    "serving": "sparkdl_tpu.serving",
+    "Server": "sparkdl_tpu.serving",
 }
 
 # Only advertise names whose modules actually exist, so `import *` works at
@@ -80,7 +83,8 @@ def __getattr__(name: str):
         raise AttributeError(
             f"sparkdl_tpu.{name} is declared in the public API but its "
             f"module {target!r} is unavailable: {e}") from e
-    # "imageIO" exposes the module itself (parity with `from sparkdl import imageIO`)
-    obj = mod if name == "imageIO" else getattr(mod, name)
+    # "imageIO"/"serving" expose the module itself (parity with
+    # `from sparkdl import imageIO`; `from sparkdl_tpu import serving`)
+    obj = mod if name in ("imageIO", "serving") else getattr(mod, name)
     globals()[name] = obj
     return obj

@@ -403,7 +403,8 @@ class DataFrame:
         return DataFrame(pa.Table.from_batches(out, schema=schema))
 
     def map_rows(self, fn: Callable[[Row], dict],
-                 batch_size: int = 1024) -> "DataFrame":
+                 batch_size: int = 1024,
+                 materialize: bool = False) -> "DataFrame":
         """Row-wise map producing a new frame (host-side; used for cheap
         struct manipulation like resize UDFs, never for model compute).
 
@@ -424,7 +425,13 @@ class DataFrame:
         the fn returns untouched is re-emitted without a Python->Arrow
         round trip, so mapping scalar columns next to an image column no
         longer pays per-row image materialization (~0.2 ms/row at 299^2
-        — PERF.md "Zero-copy map_rows")."""
+        — PERF.md "Zero-copy map_rows").
+
+        ``materialize=True`` opts OUT of the zero-copy struct views and
+        restores plain ``to_pylist`` dicts — binary struct children come
+        back as real ``bytes`` instead of ``memoryview`` — for
+        compatibility-sensitive row fns (``.decode()``, use as dict keys,
+        pickling) at the old per-row materialization cost."""
         out_tables: List[pa.Table] = []
         schema: Optional[pa.Schema] = None
         for rb in self.iter_batches(batch_size):
@@ -435,7 +442,8 @@ class DataFrame:
             for j, name in enumerate(rb.schema.names):
                 a = rb.column(j)
                 views = (_struct_view_rows(a)
-                         if pa.types.is_struct(a.type) else None)
+                         if pa.types.is_struct(a.type) and not materialize
+                         else None)
                 col_rows[name] = (views if views is not None
                                   else a.to_pylist())
             names = rb.schema.names

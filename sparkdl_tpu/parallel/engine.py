@@ -154,7 +154,9 @@ class InferenceEngine:
         # Identical per-batch math (lax.map is a scan, not a vmap, so
         # nothing about the batch dimension the model sees changes); wins
         # whenever dispatch/fetch latency rivals compute (relayed links,
-        # multi-host pods).  k=1 is the plain program.
+        # multi-host pods).  k=1 is the plain program.  map_batches scales
+        # its in-flight window to max(1, window // k) GROUPS so grouping
+        # does not silently multiply peak device residency by ~k.
         self.batches_per_dispatch = max(1, int(batches_per_dispatch))
 
         if compute_dtype is not None:
@@ -312,15 +314,20 @@ class InferenceEngine:
                     window: int = 2) -> Iterator[Any]:
         """Map over an iterator of host batches with a bounded in-flight
         window (double buffering by default): batch k+1 transfers/computes
-        while batch k is gathered.  With ``batches_per_dispatch`` > 1 the
-        window counts GROUPS (one launch of k stacked batches, ONE host
-        fetch per group); a ragged tail group runs its pieces through the
-        plain per-batch program instead of padding with whole zero
-        batches."""
+        while batch k is gathered.  With ``batches_per_dispatch`` = k > 1
+        the in-flight unit is a GROUP of k stacked batches (one launch,
+        ONE host fetch per group), so the effective window is scaled to
+        ``max(1, window // k)`` groups — peak device residency stays
+        O(window x device_batch) in HOST-BATCH terms instead of growing
+        ~k-fold with the dispatch grouping.  A ragged tail group runs its
+        pieces through the plain per-batch program instead of padding
+        with whole zero batches."""
         from collections import deque
 
         import jax
 
+        if self.batches_per_dispatch > 1:
+            window = max(1, int(window) // self.batches_per_dispatch)
         inflight: deque = deque()
 
         def drain(limit):

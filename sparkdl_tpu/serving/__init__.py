@@ -1,0 +1,41 @@
+"""sparkdl_tpu.serving — online inference over the TPU engine.
+
+The L7 layer the offline stack was missing: where transformers and UDFs
+score whole DataFrames, this package serves SINGLE requests under load —
+an async dynamic-batching front-end (clipper-style adaptive batching)
+over the same :class:`~sparkdl_tpu.parallel.engine.InferenceEngine`,
+with deadlines, backpressure, fault isolation, graceful drain, and
+latency/throughput metrics.
+
+Public surface:
+
+* :class:`Server` — ``Server(model_fn_or_named_model, ...)``; accepts a
+  zoo model name, a ``ModelFunction``, or a raw ``fn(variables, batch)``.
+* :func:`from_transformer` — lift a zoo/image/tensor transformer stage
+  into a running server.
+* ``register_serving_udf`` (``sparkdl_tpu.udf``) — expose a running
+  server as a column UDF, so offline scoring shares the online queue.
+* The error taxonomy: :class:`QueueFullError` (backpressure, carries
+  ``retry_after_s``), :class:`DeadlineExceededError` (shed before
+  dispatch), :class:`DispatchTimeoutError` (stalled model),
+  :class:`ServerClosedError`.
+"""
+
+from sparkdl_tpu.serving.adapters import from_transformer
+from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
+from sparkdl_tpu.serving.errors import (DeadlineExceededError,
+                                        DispatchTimeoutError, QueueFullError,
+                                        ServerClosedError, ServingError)
+from sparkdl_tpu.serving.server import Server
+
+__all__ = [
+    "Server",
+    "from_transformer",
+    "DynamicBatcher",
+    "Request",
+    "ServingError",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "DispatchTimeoutError",
+    "ServerClosedError",
+]

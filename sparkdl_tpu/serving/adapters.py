@@ -1,0 +1,112 @@
+"""Adapters: make existing pipeline stages servable.
+
+``from_transformer`` lifts the batch-oriented stages (zoo transformers,
+``TFImageTransformer``, ``ModelTransformer``/``KerasTransformer``) into a
+running :class:`~sparkdl_tpu.serving.server.Server`: the stage supplies
+the model (same weights, same fused preprocess, same cached zoo loads)
+and its ``batchSize`` seeds ``max_batch_size``; the serving layer adds
+the queue, dynamic batching, deadlines, and backpressure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.serving.server import Server
+
+
+def _image_request_preprocess(height: int, width: int):
+    """Host-side request prep for image servers: accepts an image-struct
+    dict (the DataFrame wire format) or a ``[H, W, 3]`` uint8 RGB array,
+    resizing to the model's input size when needed.  Runs on the
+    SUBMITTER's thread (Server.host_preprocess), never the dispatcher."""
+    from sparkdl_tpu.image.io import resizeImage, structToModelInput
+
+    def pre(example: Any) -> np.ndarray:
+        if isinstance(example, dict):  # image struct (origin/height/...)
+            return structToModelInput(example, height, width).astype(
+                np.uint8)
+        arr = np.asarray(example)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            raise ValueError(
+                f"image request must be [H, W, 3] RGB (or an image "
+                f"struct dict), got shape {arr.shape}")
+        if arr.shape[:2] != (height, width):
+            arr = resizeImage(arr.astype(np.uint8), height, width)
+        return arr.astype(np.uint8)
+
+    return pre
+
+
+def _vector_request_preprocess(example: Any) -> np.ndarray:
+    """Tensor-stage requests are 1-D float rows (the reference's
+    KerasTransformer contract)."""
+    return np.asarray(example, dtype=np.float32)
+
+
+def from_transformer(transformer, **server_kwargs) -> Server:
+    """Build a running :class:`Server` from a fitted/configured
+    transformer stage, so any zoo transformer becomes servable::
+
+        t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                modelName="InceptionV3")
+        with serving.from_transformer(t, max_wait_ms=3) as srv:
+            vec = srv.predict(rgb_array)      # same rows transform() emits
+
+    Supported stages (each keeps its own engine semantics — weights,
+    fused preprocess, compute dtype — and contributes ``batchSize`` as
+    the default ``max_batch_size``):
+
+    * ``DeepImageFeaturizer`` / ``DeepImagePredictor`` — requests are
+      ``[H, W, 3]`` uint8 RGB arrays or image-struct dicts (resized
+      host-side); results are the feature / probability rows.
+    * ``TFImageTransformer`` — same request form, routed through the
+      stage's ``ModelFunction`` (``inputSize`` must be set or inferable).
+    * ``ModelTransformer`` / ``KerasTransformer`` — requests are 1-D
+      float arrays.
+
+    Extra ``server_kwargs`` pass through to :class:`Server` (deadlines,
+    queue bound, buckets, ...).
+    """
+    from sparkdl_tpu.transformers.named_image import (TFImageTransformer,
+                                                      _NamedImageTransformer)
+    from sparkdl_tpu.transformers.tensor import ModelTransformer
+
+    if isinstance(transformer, _NamedImageTransformer):
+        from sparkdl_tpu.models import get_model_spec
+
+        name = transformer.getModelName()
+        h, w = get_model_spec(name).input_size
+        server_kwargs.setdefault("max_batch_size",
+                                 int(transformer.getBatchSize()))
+        server_kwargs.setdefault("host_preprocess",
+                                 _image_request_preprocess(h, w))
+        return Server(name, featurize=transformer.featurize,
+                      **server_kwargs)
+    if isinstance(transformer, TFImageTransformer):
+        size = _tf_image_input_size(transformer)
+        server_kwargs.setdefault("max_batch_size",
+                                 int(transformer.getBatchSize()))
+        if size is not None:
+            server_kwargs.setdefault("host_preprocess",
+                                     _image_request_preprocess(*size))
+        return Server(transformer.getModelFunction(), **server_kwargs)
+    if isinstance(transformer, ModelTransformer):
+        server_kwargs.setdefault("max_batch_size",
+                                 int(transformer.getBatchSize()))
+        server_kwargs.setdefault("host_preprocess",
+                                 _vector_request_preprocess)
+        return Server(transformer.getModelFunction(), **server_kwargs)
+    raise TypeError(
+        f"from_transformer supports the zoo/image/tensor inference stages, "
+        f"not {type(transformer).__name__}")
+
+
+def _tf_image_input_size(transformer) -> Optional[Tuple[int, int]]:
+    if transformer.isDefined(transformer.inputSize):
+        h, w = (int(v) for v in
+                transformer.getOrDefault(transformer.inputSize))
+        return h, w
+    return None

@@ -1,0 +1,200 @@
+"""Dynamic micro-batching: bounded admission queue + flush policy.
+
+The clipper-style adaptive-batching core of the serving layer: single
+requests accumulate in a bounded FIFO and flush as one micro-batch when
+the batch is full (``max_batch_size``) or the OLDEST waiting request has
+waited ``max_wait_ms`` — so light traffic pays at most one wait window of
+latency and heavy traffic amortizes dispatch over full batches.
+
+Responsibilities split: the batcher owns admission (backpressure via
+``QueueFullError``), the flush policy, and deadline shedding at flush
+time; the :class:`~sparkdl_tpu.serving.server.Server` owns bucketing,
+dispatch, and demultiplexing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, List, Optional
+
+from sparkdl_tpu.serving.errors import (DeadlineExceededError, QueueFullError,
+                                        ServerClosedError)
+from sparkdl_tpu.utils.logging import get_logger
+from sparkdl_tpu.utils.metrics import Metrics
+
+logger = get_logger(__name__)
+
+
+class Request:
+    """One admitted example: payload + completion future + queue timing.
+
+    ``deadline`` is absolute ``time.monotonic()`` seconds (None = no
+    deadline).  The future settles exactly once — with the model output
+    row, or with a serving error (shed / rejected / batch failure).
+    """
+
+    __slots__ = ("payload", "future", "enqueued_at", "deadline")
+
+    def __init__(self, payload: Any, deadline: Optional[float] = None):
+        self.payload = payload
+        self.future: Future = Future()
+        self.enqueued_at = time.monotonic()
+        self.deadline = deadline
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class DynamicBatcher:
+    """Bounded request queue with size-or-age flush.
+
+    Thread model: any number of submitter threads call :meth:`submit`;
+    ONE dispatcher thread blocks in :meth:`next_batch`.  ``close`` may be
+    called from any thread.
+    """
+
+    def __init__(self, *, max_batch_size: int = 64,
+                 max_wait_ms: float = 5.0,
+                 max_queue: int = 1024,
+                 metrics: Optional[Metrics] = None):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got "
+                             f"{max_batch_size}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.max_queue = int(max_queue)
+        # Flush-early guard: a queued request whose deadline lands INSIDE
+        # the wait window flushes this long before expiry, so a timeout
+        # shorter than max_wait_ms still dispatches under light load
+        # instead of being shed with 100% loss.  Sized above typical
+        # thread-wakeup jitter; expiry is then judged at the FLUSH
+        # DECISION (see next_batch), so scheduler overshoot between the
+        # decision and the pop can't shed a request that made the flush.
+        self.deadline_guard_s = 10e-3
+        self.metrics = metrics if metrics is not None else Metrics()
+        # Server-maintained estimate of one batch's service time; seeds the
+        # retry_after hint before the first batch completes.
+        self.batch_seconds_hint = max(self.max_wait_s, 1e-3)
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+
+    # -- admission (submitter threads) ------------------------------------
+    def submit(self, request: Request) -> None:
+        """Admit one request or raise: ``ServerClosedError`` after close,
+        ``QueueFullError`` (with a ``retry_after_s`` hint) when the queue
+        is at capacity — admission never blocks the caller."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("server is closed")
+            if len(self._q) >= self.max_queue:
+                self.metrics.incr("serving.rejected_queue_full")
+                # Capacity frees one batch at a time: full-queue drain time
+                # is (depth / batch) service periods.
+                periods = len(self._q) / self.max_batch_size
+                hint = max(1e-3, periods * self.batch_seconds_hint)
+                raise QueueFullError(
+                    f"admission queue full ({len(self._q)}/"
+                    f"{self.max_queue})", retry_after_s=hint)
+            self._q.append(request)
+            self.metrics.gauge("serving.queue_depth", float(len(self._q)))
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # -- flush (dispatcher thread) ----------------------------------------
+    def next_batch(self) -> Optional[List[Request]]:
+        """Block until a micro-batch is due; return its LIVE requests.
+
+        Flush triggers: queue holds ``max_batch_size`` requests, the
+        oldest waiting request is ``max_wait_s`` old, a queued request's
+        deadline is about to expire (within ``deadline_guard_s`` — a
+        timeout tighter than the wait window flushes early rather than
+        being shed), or the batcher is closing (drain: remaining requests
+        flush immediately).  Expired deadlines are shed HERE — after the
+        flush decision, before any device work — so a shed request costs
+        nothing downstream.  May return an empty list (whole batch shed);
+        returns None only when closed and fully drained.
+        """
+        with self._cond:
+            now = time.monotonic()
+            while True:
+                if self._q:
+                    if self._closed:
+                        break  # draining: flush whatever is left
+                    now = time.monotonic()
+                    oldest_wait = now - self._q[0].enqueued_at
+                    earliest = min(
+                        (r.deadline for r in self._q
+                         if r.deadline is not None), default=None)
+                    if (len(self._q) >= self.max_batch_size
+                            or oldest_wait >= self.max_wait_s
+                            or (earliest is not None
+                                and earliest - now <= self.deadline_guard_s)):
+                        break
+                    timeout = self.max_wait_s - oldest_wait
+                    if earliest is not None:
+                        timeout = min(timeout, earliest - now
+                                      - self.deadline_guard_s)
+                    self._cond.wait(max(timeout, 1e-4))
+                elif self._closed:
+                    return None
+                else:
+                    self._cond.wait()
+                    now = time.monotonic()
+            batch = [self._q.popleft()
+                     for _ in range(min(len(self._q), self.max_batch_size))]
+            self.metrics.gauge("serving.queue_depth", float(len(self._q)))
+        # expiry is judged at the flush DECISION: a request the guard
+        # selected while still live dispatches even if the pop itself was
+        # delayed past its deadline by scheduling jitter
+        return self._shed_expired(batch, now)
+
+    def _shed_expired(self, batch: List[Request],
+                      now: float) -> List[Request]:
+        live: List[Request] = []
+        for r in batch:
+            if r.expired(now):
+                self.metrics.incr("serving.shed_deadline")
+                try:
+                    r.future.set_exception(DeadlineExceededError(
+                        f"deadline expired after "
+                        f"{now - r.enqueued_at:.3f}s in queue"))
+                except InvalidStateError:
+                    pass  # client cancel() raced us; never kill the
+                    # dispatcher over an already-settled future
+            else:
+                live.append(r)
+        if len(live) < len(batch):
+            logger.info("shed %d expired request(s) before dispatch",
+                        len(batch) - len(live))
+        return live
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop admission.  ``drain=True`` lets the dispatcher flush the
+        remaining queue; ``drain=False`` fails every queued future with
+        ``ServerClosedError`` immediately."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._q:
+                    r = self._q.popleft()
+                    try:
+                        r.future.set_exception(
+                            ServerClosedError("server closed before "
+                                              "dispatch"))
+                    except InvalidStateError:
+                        pass  # client cancel() raced the close
+                self.metrics.gauge("serving.queue_depth", 0.0)
+            self._cond.notify_all()

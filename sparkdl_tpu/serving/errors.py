@@ -1,0 +1,42 @@
+"""Serving-layer exceptions.
+
+Every failure mode of the online path is a distinct type so callers can
+route them: retry later (``QueueFullError`` — carries ``retry_after_s``),
+tighten deadlines or shed load upstream (``DeadlineExceededError``),
+treat the model as wedged (``DispatchTimeoutError``), or stop sending
+(``ServerClosedError``).
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class of all serving-layer errors."""
+
+
+class QueueFullError(ServingError):
+    """Admission rejected: the bounded queue is full (backpressure).
+
+    ``retry_after_s`` is the server's estimate of when capacity frees up
+    (queue depth x recent per-batch service time) — the reject-with-
+    retry-after contract of clipper-style front-ends.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired while it waited in the queue; it was
+    shed before dispatch (no device work was spent on it)."""
+
+
+class DispatchTimeoutError(ServingError):
+    """The model call for this request's batch exceeded the server's
+    ``dispatch_timeout_ms``: the batch's futures fail, the stalled worker
+    is abandoned, and later batches proceed."""
+
+
+class ServerClosedError(ServingError):
+    """The server is closed (or closing): no new requests are admitted."""

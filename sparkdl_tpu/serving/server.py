@@ -1,0 +1,511 @@
+"""In-process async inference server over the TPU engine.
+
+The online counterpart of the offline paths (transformers / UDFs /
+``InferenceEngine.map_batches``): single-example requests are admitted
+into a bounded queue, assembled into dynamic micro-batches
+(:mod:`sparkdl_tpu.serving.batcher`), padded to a small set of BUCKET
+sizes so the engine's jit executable cache stays warm (a handful of
+compiled shapes, never one per request count), dispatched through the
+existing :class:`~sparkdl_tpu.parallel.engine.InferenceEngine` (same
+grouped-dispatch substrate and per-controller mesh policy), and
+demultiplexed back to per-request futures.
+
+Production envelope:
+  * per-request deadlines — expired requests are shed BEFORE dispatch;
+  * bounded admission queue — reject-with-``retry_after_s`` when full;
+  * per-batch fault isolation — a model fn that raises (after the
+    configured ``utils.retry`` budget) or stalls past
+    ``dispatch_timeout_ms`` fails only its OWN batch's futures;
+  * graceful drain on ``close()`` / context-manager exit;
+  * ``utils.metrics``-integrated counters/gauges/latency histograms
+    (queue depth, batch fill ratio, time-in-queue, p50/p99 latency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
+from sparkdl_tpu.serving.errors import (DispatchTimeoutError,
+                                        ServerClosedError)
+from sparkdl_tpu.utils.logging import get_logger
+from sparkdl_tpu.utils.metrics import Metrics
+from sparkdl_tpu.utils.retry import NON_RETRYABLE, with_retries
+
+logger = get_logger(__name__)
+
+
+def _resolve_model(model, variables, featurize: bool):
+    """(fn, host_variables, engine_overrides) from the three accepted
+    model forms:
+
+    * a zoo model NAME (str) — weights via the shared process cache, the
+      model's ImageNet preprocess fused in front (``featurize`` picks the
+      feature cut vs. probabilities), uint8 RGB ``[B, H, W, 3]`` input.
+      Honors ``SPARKDL_ZOO_COMPUTE_DTYPE`` exactly like the zoo
+      transformers (``named_image._zoo_engine``) — bf16 compute with f32
+      host cast under the bench configuration — so served rows match
+      ``transform()`` rows; the dtype choice rides ``engine_overrides``
+      (applied unless the caller set the knobs explicitly);
+    * a :class:`~sparkdl_tpu.graph.function.ModelFunction`;
+    * a plain jit-traceable ``fn(variables, batch)`` plus ``variables``.
+    """
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    if isinstance(model, str):
+        if variables is not None:
+            raise ValueError("variables must be None when serving a named "
+                             "zoo model")
+        import os
+
+        from sparkdl_tpu.models import get_model_spec
+        from sparkdl_tpu.transformers.named_image import _cached_model
+
+        spec = get_model_spec(model)
+        module, zoo_vars = _cached_model(model)
+        pre = spec.preprocess
+        cdt_name = os.environ.get("SPARKDL_ZOO_COMPUTE_DTYPE", "").lower()
+        if cdt_name not in ("", "float32", "f32", "bfloat16", "bf16"):
+            raise ValueError(
+                f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; "
+                f"use 'bfloat16' or 'float32'")
+        bf16 = cdt_name in ("bfloat16", "bf16")
+        overrides = {}
+        if bf16:
+            import jax.numpy as jnp
+            import numpy as _np
+
+            overrides = {"compute_dtype": jnp.bfloat16,
+                         "output_host_dtype": _np.float32}
+
+        def fn(v, x):  # x: uint8 RGB [B,H,W,3]
+            xf = pre(x)
+            if bf16:
+                import jax.numpy as jnp
+
+                xf = xf.astype(jnp.bfloat16)
+            return module.apply(v, xf, train=False, features=featurize)
+
+        return fn, zoo_vars, overrides
+    if isinstance(model, ModelFunction):
+        if variables is not None:
+            raise ValueError("variables must be None when serving a "
+                             "ModelFunction (it carries its own)")
+        return model.fn, model.variables, {}
+    if callable(model):
+        return model, ({} if variables is None else variables), {}
+    raise TypeError(f"Cannot serve a {type(model).__name__}; expected a "
+                    f"zoo model name, ModelFunction, or callable "
+                    f"fn(variables, batch)")
+
+
+def _default_buckets(max_batch_size: int) -> List[int]:
+    """Quarter / half / full batch — three compiled shapes cover light,
+    medium, and saturated traffic without per-count recompiles."""
+    b = max(1, int(max_batch_size))
+    return sorted({max(1, b // 4), max(1, b // 2), b})
+
+
+class _Once:
+    """Run a callback exactly once across racing threads (worker finish
+    vs. stall watchdog)."""
+
+    def __init__(self, fn: Callable[[], None]):
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._done = False
+
+    def __call__(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        self._fn()
+
+
+def _settle_error(requests: Sequence[Request], exc: BaseException) -> None:
+    for r in requests:
+        if not r.future.done():
+            try:
+                r.future.set_exception(exc)
+            except InvalidStateError:  # lost a race with the watchdog
+                pass
+
+
+class Server:
+    """Async dynamic-batching inference service over one model.
+
+    ::
+
+        with serving.Server(fn, variables, max_batch_size=64,
+                            max_wait_ms=5) as srv:
+            fut = srv.submit(example)           # concurrent.futures.Future
+            y = fut.result()
+            y = srv.predict(example)            # blocking sugar
+            y = await srv.predict_async(example)  # asyncio integration
+
+    Requests are single examples WITHOUT the batch axis (arrays or
+    pytrees); results are the matching single-example output rows —
+    bit-identical to batching the same inputs through
+    ``InferenceEngine.map_batches`` at the same padded shape, regardless
+    of arrival order or which micro-batch a request lands in (across
+    DIFFERENT bucket shapes results agree to XLA-refusion tolerance, the
+    same caveat as the engine's own grouped dispatch).
+
+    Parameters beyond the batcher knobs:
+      * ``bucket_sizes`` — padded dispatch sizes (default quarter/half/
+        full ``max_batch_size``); each bucket is one compiled shape.
+      * ``default_timeout_ms`` — deadline applied to requests that pass
+        no ``timeout_ms`` of their own (None = no deadline).
+      * ``dispatch_timeout_ms`` — stall watchdog: a model-call ATTEMPT
+        exceeding this fails its batch with ``DispatchTimeoutError`` and
+        later batches proceed (None = wait forever).  The window is
+        re-armed per retry attempt and excludes both jit compile (each
+        bucket's first batch triggers an untimed warm call) and the
+        host-side demux.
+      * ``max_retries`` — per-batch ``utils.retry.with_retries`` budget
+        for transient model failures (default 0: fail fast; deterministic
+        errors in ``retry.NON_RETRYABLE`` never retry).
+      * ``max_inflight_batches`` — dispatch concurrency bound (device
+        residency stays O(inflight x bucket), mirroring the engine's
+        in-flight window).
+      * ``host_preprocess`` — optional per-request host-side fn applied
+        in ``submit`` on the CALLER's thread (e.g. image resize), so the
+        dispatcher never blocks on host prep.
+    """
+
+    def __init__(self, model, variables: Any = None, *,
+                 featurize: bool = False,
+                 max_batch_size: int = 64,
+                 max_wait_ms: float = 5.0,
+                 max_queue: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 dispatch_timeout_ms: Optional[float] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 max_inflight_batches: int = 2,
+                 max_retries: int = 0,
+                 retry_backoff_s: float = 0.0,
+                 mesh=None,
+                 compute_dtype: Optional[Any] = None,
+                 output_host_dtype: Optional[Any] = None,
+                 host_preprocess: Optional[Callable[[Any], Any]] = None,
+                 metrics: Optional[Metrics] = None):
+        self._fn, self._host_variables, _overrides = _resolve_model(
+            model, variables, featurize)
+        if compute_dtype is None and output_host_dtype is None:
+            compute_dtype = _overrides.get("compute_dtype")
+            output_host_dtype = _overrides.get("output_host_dtype")
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.max_batch_size = max(1, int(max_batch_size))
+        buckets = (list(bucket_sizes) if bucket_sizes is not None
+                   else _default_buckets(self.max_batch_size))
+        if not buckets or any(int(b) < 1 for b in buckets):
+            raise ValueError(f"bucket_sizes must be positive, got {buckets}")
+        self._buckets = sorted(int(b) for b in buckets)
+        if self._buckets[-1] < self.max_batch_size:
+            raise ValueError(
+                f"largest bucket ({self._buckets[-1]}) must cover "
+                f"max_batch_size ({self.max_batch_size})")
+        self._default_timeout_s = (None if default_timeout_ms is None
+                                   else max(0.0, default_timeout_ms) / 1e3)
+        self._dispatch_timeout_s = (None if dispatch_timeout_ms is None
+                                    else max(1e-3, dispatch_timeout_ms) / 1e3)
+        self._max_retries = max(0, int(max_retries))
+        self._retry_backoff_s = max(0.0, float(retry_backoff_s))
+        self._mesh = mesh
+        self._compute_dtype = compute_dtype
+        self._output_host_dtype = output_host_dtype
+        self._host_preprocess = host_preprocess
+        self._engines: Dict[int, Any] = {}
+        self._warm: set = set()  # buckets whose program is compiled
+        self._engine_lock = threading.Lock()
+        self._batcher = DynamicBatcher(
+            max_batch_size=self.max_batch_size, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, metrics=self.metrics)
+        self._closed = False
+        self._abandon = threading.Event()
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._inflight_sem = threading.Semaphore(
+            max(1, int(max_inflight_batches)))
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="sparkdl-serving-dispatch")
+        self._dispatcher.start()
+
+    # -- engines (one per bucket, shared weights + shared jit program) ----
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _engine_for(self, bucket: int):
+        with self._engine_lock:
+            eng = self._engines.get(bucket)
+            if eng is None:
+                from sparkdl_tpu.parallel.engine import InferenceEngine
+
+                first = next(iter(self._engines.values()), None)
+                # Buckets share ONE device copy of the weights (device_put
+                # of an already-replicated pytree is a no-op) and ONE jit
+                # program (module-level engine cache keyed on fn/mesh) —
+                # each bucket only adds one executable for its shape.
+                eng = InferenceEngine(
+                    self._fn,
+                    first.variables if first is not None
+                    else self._host_variables,
+                    mesh=first.mesh if first is not None else self._mesh,
+                    device_batch_size=bucket,
+                    compute_dtype=(None if first is not None
+                                   else self._compute_dtype),
+                    output_host_dtype=self._output_host_dtype,
+                    metrics=self.metrics)
+                self._engines[bucket] = eng
+            return eng
+
+    def warmup(self, example: Any) -> None:
+        """Compile every bucket's program ahead of traffic (one dummy
+        dispatch per bucket shaped like ``example``, a single request
+        payload) so first requests never pay compile time."""
+        import jax
+
+        if self._host_preprocess is not None:
+            example = self._host_preprocess(example)
+        example = jax.tree_util.tree_map(np.asarray, example)
+        for b in self._buckets:
+            eng = self._engine_for(b)
+            stacked = jax.tree_util.tree_map(
+                lambda a: np.stack([a] * eng.device_batch_size), example)
+            eng(stacked)
+            self._warm.add(b)
+
+    # -- request path ------------------------------------------------------
+    def submit(self, example: Any,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one example; returns its ``concurrent.futures.Future``.
+
+        Raises ``ServerClosedError`` after close and ``QueueFullError``
+        (with ``retry_after_s``) under backpressure.  ``timeout_ms``
+        overrides the server's ``default_timeout_ms`` deadline.
+        """
+        if self._closed:
+            raise ServerClosedError("server is closed")
+        if self._host_preprocess is not None:
+            example = self._host_preprocess(example)
+        import jax
+
+        example = jax.tree_util.tree_map(np.asarray, example)
+        timeout_s = (self._default_timeout_s if timeout_ms is None
+                     else max(0.0, timeout_ms) / 1e3)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        req = Request(example, deadline)
+        self.metrics.incr("serving.requests")
+        self._batcher.submit(req)
+        return req.future
+
+    def predict(self, example: Any,
+                timeout_ms: Optional[float] = None) -> Any:
+        """Blocking single-request convenience: submit + wait."""
+        return self.submit(example, timeout_ms=timeout_ms).result()
+
+    async def predict_async(self, example: Any,
+                            timeout_ms: Optional[float] = None) -> Any:
+        """Awaitable form for asyncio handlers (wraps the submit future)."""
+        import asyncio
+
+        return await asyncio.wrap_future(
+            self.submit(example, timeout_ms=timeout_ms))
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._batcher.next_batch()
+            if batch is None:
+                return  # closed and drained
+            if not batch:
+                continue  # every request shed at flush
+            # interruptible slot wait: if close() abandons a wedged server
+            # (no watchdog configured), the batches the dispatcher holds
+            # must still SETTLE — clients block in result() forever
+            # otherwise
+            acquired = False
+            while not acquired and not self._abandon.is_set():
+                acquired = self._inflight_sem.acquire(timeout=0.1)
+            if not acquired:
+                _settle_error(batch, ServerClosedError(
+                    "server close abandoned a wedged dispatch; request "
+                    "was never dispatched"))
+                continue
+            with self._inflight_cond:
+                self._inflight += 1
+            worker = threading.Thread(
+                target=self._run_batch, args=(batch,), daemon=True,
+                name="sparkdl-serving-batch")
+            worker.start()
+
+    def _finish_batch(self) -> None:
+        self._inflight_sem.release()
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    def _run_batch(self, requests: List[Request]) -> None:
+        finish = _Once(self._finish_batch)
+        try:
+            self._execute(requests, finish)
+        except BaseException as e:  # noqa: BLE001 — isolate to this batch
+            self.metrics.incr("serving.batch_failures")
+            _settle_error(requests, e)
+            logger.warning("serving batch of %d failed: %s: %s",
+                           len(requests), type(e).__name__, e)
+        finally:
+            finish()
+
+    def _guarded_call(self, eng, stacked, requests: List[Request],
+                      finish: _Once):
+        """One model-call ATTEMPT under the stall watchdog.  The timer is
+        armed per attempt (retry backoff and later attempts get their own
+        window, so configuring retries never silently nullifies them) and
+        covers ONLY the engine call — compile time is excluded by the
+        untimed warm call in ``_execute``, and the host-side demux runs
+        after the timer is disarmed."""
+        if self._dispatch_timeout_s is None:
+            return eng(stacked)
+        attempt_done = threading.Event()
+
+        def on_stall():
+            if attempt_done.is_set():
+                return
+            self.metrics.incr("serving.dispatch_timeouts")
+            self.metrics.incr("serving.batch_failures")
+            _settle_error(requests, DispatchTimeoutError(
+                f"model call exceeded "
+                f"{self._dispatch_timeout_s * 1e3:.0f}ms; batch of "
+                f"{len(requests)} abandoned"))
+            # free the concurrency slot the wedged worker holds so later
+            # batches keep flowing
+            finish()
+
+        timer = threading.Timer(self._dispatch_timeout_s, on_stall)
+        timer.daemon = True
+        timer.start()
+        try:
+            return eng(stacked)
+        finally:
+            attempt_done.set()
+            timer.cancel()
+
+    def _execute(self, requests: List[Request], finish: _Once) -> None:
+        import jax
+
+        n = len(requests)
+        now = time.monotonic()
+        for r in requests:
+            self.metrics.record_time("serving.time_in_queue",
+                                     now - r.enqueued_at)
+        bucket = self._bucket_for(n)
+        eng = self._engine_for(bucket)
+        stacked = jax.tree_util.tree_map(
+            lambda *rows: np.stack(rows, axis=0),
+            *[r.payload for r in requests])
+        if self._dispatch_timeout_s is not None and bucket not in self._warm:
+            # compile OUTSIDE the watchdog window: the first call to a
+            # bucket jits the program (seconds for real models), which
+            # would otherwise eat any production-sized dispatch timeout
+            eng(jax.tree_util.tree_map(np.zeros_like, stacked))
+            self._warm.add(bucket)
+        t0 = time.monotonic()
+        out = with_retries(
+            lambda: self._guarded_call(eng, stacked, requests, finish),
+            max_retries=self._max_retries,
+            non_retryable=NON_RETRYABLE,
+            backoff_seconds=self._retry_backoff_s)
+        batch_s = time.monotonic() - t0
+        self._batcher.batch_seconds_hint = batch_s
+        self.metrics.incr("serving.batches")
+        self.metrics.record_time("serving.batch_latency", batch_s)
+        self.metrics.observe("serving.batch_fill_ratio",
+                             n / eng.device_batch_size)
+        done = time.monotonic()
+        for i, r in enumerate(requests):
+            if r.future.done():
+                continue  # watchdog raced us; result discarded
+            # copy, don't view: a retained row must pin O(row), not the
+            # whole [bucket, ...] batch output it was sliced from
+            row = jax.tree_util.tree_map(
+                lambda a: np.array(a[i], copy=True), out)
+            try:
+                r.future.set_result(row)
+                self.metrics.incr("serving.completed")
+                self.metrics.record_time("serving.request_latency",
+                                         done - r.enqueued_at)
+            except InvalidStateError:
+                pass
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def queue_depth(self) -> int:
+        return self._batcher.depth()
+
+    def stats(self) -> Dict[str, float]:
+        """Snapshot of the serving metrics (counters, gauges, latency
+        p50/p99 — see ``utils.metrics.Metrics.summary``)."""
+        return {k: v for k, v in self.metrics.summary().items()
+                if k.startswith("serving.") or k.startswith("engine_")}
+
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = 30.0) -> None:
+        """Stop the server.  ``drain=True`` (graceful): stop admission,
+        flush and serve everything already queued, wait for in-flight
+        batches.  ``drain=False``: queued requests fail with
+        ``ServerClosedError``; in-flight batches are still awaited.
+        Idempotent.
+
+        If the drain cannot complete within ``timeout_s`` (a wedged model
+        call with no ``dispatch_timeout_ms`` configured), the wait is
+        abandoned and every request NOT in the wedged batch itself is
+        settled with ``ServerClosedError`` — only futures inside a batch
+        whose model call never returns stay pending (configure
+        ``dispatch_timeout_ms`` to bound that case too)."""
+        if self._closed:
+            self._batcher.close(drain=drain)
+            return
+        self._closed = True
+        self._batcher.close(drain=drain)
+        self._dispatcher.join(timeout=timeout_s)
+        if self._dispatcher.is_alive():
+            logger.warning(
+                "close(): dispatcher still busy after %ss; abandoning — "
+                "undispatched requests fail with ServerClosedError",
+                timeout_s)
+            self._abandon.set()
+            self._dispatcher.join(timeout=5.0)
+            self._batcher.close(drain=False)  # settle anything still queued
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    logger.warning("close(): %d batch(es) still in flight "
+                                   "after %.1fs; abandoning wait",
+                                   self._inflight, timeout_s)
+                    return
+                self._inflight_cond.wait(remaining)
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)

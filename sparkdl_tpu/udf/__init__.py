@@ -11,10 +11,10 @@ works on a Spark cluster with TPU-backed execution.
 """
 
 from sparkdl_tpu.udf.registry import (UDFRegistry, register_image_udf,
-                                      register_udf, registerKerasImageUDF,
-                                      udf_registry)
+                                      register_serving_udf, register_udf,
+                                      registerKerasImageUDF, udf_registry)
 
 __all__ = [
-    "UDFRegistry", "register_image_udf", "register_udf",
-    "registerKerasImageUDF", "udf_registry",
+    "UDFRegistry", "register_image_udf", "register_serving_udf",
+    "register_udf", "registerKerasImageUDF", "udf_registry",
 ]

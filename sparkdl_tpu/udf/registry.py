@@ -216,6 +216,67 @@ class _EngineHolder:
     """Plain object whose __dict__ hosts get_cached_engine's cache."""
 
 
+def register_serving_udf(name: str, server, *, returns: str = "array<float>",
+                         max_admission_retries: int = 100,
+                         timeout_ms: float = float("inf"),
+                         registry: Optional[UDFRegistry] = None
+                         ) -> RegisteredUDF:
+    """Register a running ``serving.Server`` as a column UDF.
+
+    Each row becomes ONE request on the server's admission queue, so
+    offline column scoring and any concurrent online traffic share the
+    same dynamic micro-batches, deadlines, and metrics — the offline API
+    riding the online path.  All rows are submitted asynchronously before
+    any result is awaited, letting the batcher fill micro-batches instead
+    of ping-ponging one row at a time.
+
+    Backpressure is honored, not bypassed: a ``QueueFullError`` sleeps the
+    server's ``retry_after_s`` hint and resubmits, up to
+    ``max_admission_retries`` per row.  Null rows stay null.
+
+    Offline rows carry NO deadline by default (``timeout_ms=inf``
+    overrides the server's ``default_timeout_ms``): a bulk column submit
+    parks most rows deep in the queue, where an online-sized deadline
+    would shed the tail and fail the whole apply — offline flow control
+    is the backpressure loop above, not deadlines.  Pass a finite
+    ``timeout_ms`` to opt back in to shedding.
+    """
+    import time as _time
+
+    from sparkdl_tpu.serving.errors import QueueFullError
+
+    def _submit_with_backoff(value):
+        for _ in range(max(1, int(max_admission_retries))):
+            try:
+                return server.submit(value, timeout_ms=timeout_ms)
+            except QueueFullError as e:
+                _time.sleep(max(1e-3, e.retry_after_s))
+        # final attempt: let rejection raise
+        return server.submit(value, timeout_ms=timeout_ms)
+
+    def fn(rows) -> List[Optional[list]]:
+        if isinstance(rows, (pa.Array, pa.ChunkedArray)):
+            rows = rows.to_pylist()
+        out: List[Optional[list]] = [None] * len(rows)
+        futures = []
+        for i, r in enumerate(rows):
+            if r is None:
+                continue
+            if isinstance(r, (list, tuple)):
+                # arrow list rows arrive as Python lists; submit() treats
+                # a list as a PYTREE of scalars, so densify here (struct
+                # rows stay dicts — the server's host_preprocess owns those)
+                r = np.asarray(r, dtype=np.float32)
+            futures.append((i, _submit_with_backoff(r)))
+        for i, fut in futures:
+            res = np.asarray(fut.result())
+            out[i] = [float(v) for v in res.reshape(-1)]
+        return out
+
+    registry = registry if registry is not None else udf_registry
+    return registry.register(name, fn, returns=returns)
+
+
 def registerKerasImageUDF(name: str, model_or_file, preprocessor=None,
                           registry: Optional[UDFRegistry] = None
                           ) -> RegisteredUDF:

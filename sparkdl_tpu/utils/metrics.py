@@ -4,11 +4,24 @@ SURVEY.md §5: the reference had no metrics at all (Spark UI only); the TPU
 build makes images/sec/chip a first-class counter since it is the baseline
 metric.  Timers bracket device work with ``jax.block_until_ready`` so async
 dispatch doesn't fake speedups.
+
+The serving layer (sparkdl_tpu.serving) adds concurrent writers (admission
+thread + dispatch workers), so every mutation takes a process-local lock,
+and adds latency-distribution consumers, so timing/observation series
+expose percentiles (``percentile``) and ``summary`` carries p50/p99.
+
+Series are BOUNDED: each timing/histogram list keeps at most
+``max_samples`` recent samples (the oldest half is dropped on overflow),
+so a long-running server records per-request latency forever without
+growing without limit — percentiles/means then describe the recent
+window, while counters stay cumulative.
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -16,29 +29,82 @@ from typing import Dict, List, Optional
 
 @dataclass
 class Metrics:
-    """A tiny metrics registry: named counters + gauges + timing lists."""
+    """A tiny metrics registry: named counters + gauges + timing lists +
+    unitless observation histograms (e.g. batch fill ratios, queue depths).
+    """
 
     counters: Dict[str, float] = field(default_factory=dict)
     gauges: Dict[str, float] = field(default_factory=dict)
     timings_s: Dict[str, List[float]] = field(default_factory=dict)
+    histograms: Dict[str, List[float]] = field(default_factory=dict)
+    # Per-series sample bound: on overflow the OLDEST half is dropped, so
+    # a server recording per-request latency indefinitely holds O(cap)
+    # floats per series, and percentiles describe the recent window.
+    max_samples: int = 16384
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  init=False, repr=False, compare=False)
 
     def incr(self, name: str, value: float = 1.0):
-        self.counters[name] = self.counters.get(name, 0.0) + value
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
 
     def gauge(self, name: str, value: float):
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
+
+    def _append_bounded(self, series: List[float], value: float):
+        series.append(value)
+        if self.max_samples and len(series) > self.max_samples:
+            del series[:len(series) // 2]
 
     def record_time(self, name: str, seconds: float):
-        self.timings_s.setdefault(name, []).append(seconds)
+        with self._lock:
+            self._append_bounded(self.timings_s.setdefault(name, []),
+                                 seconds)
+
+    def observe(self, name: str, value: float):
+        """Append one sample to the unitless histogram ``name`` (for
+        non-time distributions: batch fill ratio, queue depth, ...)."""
+        with self._lock:
+            self._append_bounded(self.histograms.setdefault(name, []),
+                                 float(value))
+
+    @staticmethod
+    def _percentile(values: List[float], q: float) -> float:
+        """Nearest-rank percentile (q in [0, 100]) of a non-empty list."""
+        vs = sorted(values)
+        k = max(0, min(len(vs) - 1, math.ceil(q / 100.0 * len(vs)) - 1))
+        return vs[k]
+
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        """Percentile of a timing or histogram series; None when the
+        series is absent/empty."""
+        with self._lock:
+            series = self.timings_s.get(name) or self.histograms.get(name)
+            series = list(series) if series else None
+        if not series:
+            return None
+        return self._percentile(series, q)
 
     def summary(self) -> Dict[str, float]:
-        out = dict(self.counters)
-        out.update(self.gauges)
-        for k, v in self.timings_s.items():
+        with self._lock:
+            out = dict(self.counters)
+            out.update(self.gauges)
+            timings = {k: list(v) for k, v in self.timings_s.items()}
+            hists = {k: list(v) for k, v in self.histograms.items()}
+        for k, v in timings.items():
             if v:
                 out[f"{k}.mean_s"] = sum(v) / len(v)
                 out[f"{k}.total_s"] = sum(v)
                 out[f"{k}.count"] = len(v)
+                out[f"{k}.p50_s"] = self._percentile(v, 50)
+                out[f"{k}.p99_s"] = self._percentile(v, 99)
+        for k, v in hists.items():
+            if v:
+                out[f"{k}.mean"] = sum(v) / len(v)
+                out[f"{k}.count"] = len(v)
+                out[f"{k}.p50"] = self._percentile(v, 50)
+                out[f"{k}.p99"] = self._percentile(v, 99)
         return out
 
     @contextlib.contextmanager

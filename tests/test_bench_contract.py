@@ -93,6 +93,52 @@ def test_retry_nontimeout_failure_does_not_skip_configs(captured,
     assert not any("skipped" in (r.get("error") or "") for r in captured)
 
 
+def test_emit_extra_fields_merge_without_touching_core_keys(captured):
+    """The serving line carries p50/p99 next to the core contract keys;
+    ``extra`` must merge, never shadow, the core fields."""
+    bench.emit("serving", "m", 1234.5, "images/sec",
+               extra={"p50_ms": 4.2, "p99_ms": 9.9, "num_requests": 64})
+    rec = captured[-1]
+    assert rec["value"] == 1234.5 and rec["unit"] == "images/sec"
+    assert rec["p50_ms"] == 4.2 and rec["p99_ms"] == 9.9
+    assert rec["vs_baseline"] is None and rec["baseline"] is None
+    # a colliding key is a loud error, never a silent overwrite
+    with pytest.raises(ValueError, match="collides"):
+        bench.emit("serving", "m", 1.0, "images/sec",
+                   extra={"value": 2.0})
+
+
+def test_serving_config_runs_on_cpu_fallback_when_relay_dead(captured,
+                                                             monkeypatch):
+    """Dead relay: every device config is skipped, but 'serving' still
+    runs end-to-end pinned to host CPU and its JSON line parses under the
+    contract with the latency fields present — the serving config can
+    never silently emit malformed JSON."""
+    def dead_probe(timeout_s=240):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout_s)
+
+    monkeypatch.setattr(bench, "measure_relay_profile", dead_probe)
+    monkeypatch.setattr(bench, "RELAY", {})
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1,serving")
+    monkeypatch.setenv("SPARKDL_BENCH_SERVING_REQUESTS", "32")
+    bench.main()
+    by_config = {}
+    for r in captured:
+        by_config.setdefault(r["config"], r)
+    assert "unreachable" in by_config["relay"]["error"]
+    assert "skipped" in by_config["1"]["error"]
+    rec = by_config["serving"]
+    assert "error" not in rec, rec
+    assert rec["unit"] == "images/sec" and rec["value"] > 0
+    assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+    assert rec["num_requests"] == 32
+    assert "cpu-fallback" in rec["env_bound"]
+    # contract keys stay intact on the serving line
+    for key in ("config", "metric", "value", "unit", "vs_baseline",
+                "baseline", "env_bound"):
+        assert key in rec
+
+
 def test_relay_tag_formats_measured_profile(monkeypatch):
     monkeypatch.setattr(bench, "RELAY", {})
     assert "unmeasured" in bench._relay_tag()

@@ -163,6 +163,28 @@ def test_map_rows_struct_zero_copy_passthrough():
     assert [r["k2"] for r in out.collect()] == [i * 2 for i in range(8)]
 
 
+def test_map_rows_materialize_restores_bytes():
+    """materialize=True opts out of the zero-copy struct views: fns get
+    plain to_pylist dicts whose binary children are real ``bytes`` (for
+    .decode()/dict-key/pickle-sensitive row fns — advisor round-5), at
+    the old materialization cost; outputs match the view path."""
+    df = _image_frame()
+    seen_types = []
+    out = df.map_rows(lambda r: seen_types.append(type(
+        r["image"] and r["image"]["data"])) or
+        {"image": r["image"], "k2": r["k"] * 2}, batch_size=3,
+        materialize=True)
+    assert seen_types and memoryview not in seen_types
+    assert bytes in seen_types
+    # same ROWS as the zero-copy path (materialize re-infers the struct
+    # schema from plain dicts, so compare values, not arrow types)
+    ref = df.map_rows(lambda r: {"image": r["image"], "k2": r["k"] * 2},
+                      batch_size=3)
+    assert (out.table.column("image").to_pylist()
+            == ref.table.column("image").to_pylist())
+    assert [r["k2"] for r in out.collect()] == [i * 2 for i in range(8)]
+
+
 def test_map_rows_struct_modified_and_nulled():
     """Modified structs materialize normally (resize UDF path) and a fn
     nulling a live row defeats the passthrough, not the null contract."""

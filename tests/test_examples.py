@@ -36,6 +36,16 @@ def test_migration_example_runs(tmp_path):
 
 
 @pytest.mark.slow
+def test_serving_quickstart_example_runs(tmp_path):
+    """The serving subsystem's executable documentation (threads,
+    asyncio, transformer parity, shared-queue UDF) — keep it green."""
+    _run_example(
+        "serving_quickstart.py", '"serving_quickstart": "ok"', tmp_path,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+
+
+@pytest.mark.slow
 def test_distributed_fit_example_runs(tmp_path):
     """The multi-controller training example (2 processes x 2 virtual
     devices, dp=4, vs a single-controller oracle) is the topology

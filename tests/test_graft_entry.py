@@ -25,6 +25,32 @@ def test_dryrun_subprocess_fallback_when_devices_insufficient():
     __graft_entry__.dryrun_multichip(16)
 
 
+def test_dryrun_pins_cpu_platform_before_device_probe(monkeypatch):
+    """The MULTICHIP hang mode: probing ``len(jax.devices())`` with no
+    platform pinned initializes the default backend, which blocks forever
+    on a dead TPU relay.  The probe must be preceded by the same
+    ``jax.config.update('jax_platforms', 'cpu')`` pin the subprocess and
+    conftest use."""
+    import jax
+
+    calls = []
+    orig_update, orig_devices = jax.config.update, jax.devices
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda k, v: (calls.append(("update", k, v)), orig_update(k, v))[1])
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a, **kw: (calls.append(("devices",)),
+                          orig_devices(*a, **kw))[1])
+    # the probe decision is what's under test, not the step itself
+    monkeypatch.setattr(__graft_entry__, "_dryrun_impl", lambda n: None)
+    __graft_entry__.dryrun_multichip(8)
+    pin = ("update", "jax_platforms", "cpu")
+    assert pin in calls
+    assert ("devices",) in calls
+    assert calls.index(pin) < calls.index(("devices",))
+
+
 def test_entry_compiles_single_chip():
     import jax
 

@@ -1,0 +1,522 @@
+"""Serving-layer tests (tier-1, CPU-only, 8-device virtual mesh).
+
+Pins the acceptance contract of sparkdl_tpu.serving: results bitwise
+identical to direct ``InferenceEngine.map_batches`` regardless of request
+arrival order/interleaving, deadline shedding BEFORE dispatch,
+bounded-queue backpressure with retry-after, per-batch fault isolation
+(raising AND stalling model fns, retry wiring through utils.retry),
+graceful drain, the transformer/UDF adapters, and the metrics surface.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.serving import (DeadlineExceededError, DispatchTimeoutError,
+                                 QueueFullError, Server, ServerClosedError,
+                                 from_transformer)
+
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"] + variables["b"])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(11)
+    variables = {
+        "w": rng.normal(size=(12, 5)).astype(np.float32),
+        "b": rng.normal(size=(5,)).astype(np.float32),
+    }
+    x = rng.normal(size=(45, 12)).astype(np.float32)
+    return variables, x
+
+
+# -- correctness -----------------------------------------------------------
+
+def test_results_bitwise_match_engine_any_arrival_order(setup):
+    """Every request's result must be byte-for-byte what direct
+    ``InferenceEngine.map_batches`` produces for the same example — across
+    shuffled submission order and concurrent submitter interleaving (the
+    micro-batch composition a request lands in must not leak into its
+    numbers)."""
+    variables, x = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=16)
+    ref = np.concatenate(list(eng.map_batches([x])), axis=0)
+
+    with Server(_fn, variables, max_batch_size=16, max_wait_ms=5,
+                bucket_sizes=[16], max_queue=256) as srv:
+        results = [None] * len(x)
+        order = np.random.default_rng(3).permutation(len(x))
+
+        def client(idxs):
+            futs = [(int(i), srv.submit(x[int(i)])) for i in idxs]
+            for i, f in futs:
+                results[i] = np.asarray(f.result(timeout=60))
+
+        threads = [threading.Thread(target=client, args=(order[lo::3],))
+                   for lo in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    got = np.stack(results)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pytree_requests_and_results(setup):
+    """Pytree payloads stack per-leaf and demux per-row, preserving
+    integer leaves (argmax ids never floated)."""
+    variables, x = setup
+
+    def fn(v, xb):
+        import jax.numpy as jnp
+
+        y = jnp.tanh(xb["a"] @ v["w"] + v["b"])
+        return {"y": y, "ids": jnp.argmax(y, axis=-1)}
+
+    plain = InferenceEngine(fn, variables, device_batch_size=8)
+    ref = plain({"a": x})
+    with Server(fn, variables, max_batch_size=8, max_wait_ms=5,
+                bucket_sizes=[8]) as srv:
+        futs = [srv.submit({"a": x[i]}) for i in range(len(x))]
+        rows = [f.result(timeout=60) for f in futs]
+    np.testing.assert_array_equal(np.stack([r["y"] for r in rows]),
+                                  ref["y"])
+    ids = np.stack([r["ids"] for r in rows])
+    np.testing.assert_array_equal(ids, ref["ids"])
+    assert ids.dtype.kind in "iu"
+
+
+def test_bucket_padding_keeps_fill_ratio_honest(setup):
+    """A light micro-batch dispatches through the SMALLEST covering
+    bucket, and the fill-ratio histogram records n/bucket."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=16, max_wait_ms=5,
+                bucket_sizes=[8, 16]) as srv:
+        futs = [srv.submit(x[i]) for i in range(3)]
+        for f in futs:
+            f.result(timeout=60)
+        # allow the worker to finish metric writes after settling futures
+        deadline = time.monotonic() + 5
+        while (not srv.metrics.histograms.get("serving.batch_fill_ratio")
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        fills = srv.metrics.histograms["serving.batch_fill_ratio"]
+        # 3 requests -> bucket 8 (8-device mesh keeps it at 8): fill 3/8
+        assert fills and abs(fills[0] - 3 / 8) < 1e-9
+        assert list(srv._engines) == [8]
+
+
+# -- deadlines / backpressure ---------------------------------------------
+
+def test_expired_deadlines_shed_before_dispatch(setup):
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=4, max_wait_ms=30,
+                bucket_sizes=[4]) as srv:
+        doomed = [srv.submit(x[i], timeout_ms=0) for i in range(2)]
+        live = [srv.submit(x[i]) for i in range(2)]  # 4th fills the batch
+        for f in doomed:
+            with pytest.raises(DeadlineExceededError):
+                f.result(timeout=60)
+        for f in live:
+            np.asarray(f.result(timeout=60))
+        s = srv.metrics.summary()
+    assert s["serving.shed_deadline"] == 2
+    assert s["serving.completed"] == 2
+    # shed requests never reached the engine: dispatched batch held 2 rows
+    assert s["serving.batches"] == 1
+
+
+def test_timeout_tighter_than_wait_window_still_serves(setup):
+    """A deadline SHORTER than max_wait_ms must flush early and serve
+    under light load — not wait out the window and shed 100% of
+    traffic."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=64, max_wait_ms=5_000,
+                bucket_sizes=[64], default_timeout_ms=500) as srv:
+        np.asarray(srv.predict(x[0]))  # would be shed at the 5s flush
+        assert srv.metrics.counters.get("serving.shed_deadline", 0) == 0
+
+
+def test_queue_full_rejects_with_retry_after(setup):
+    variables, x = setup
+    # Nothing flushes (batch never fills, wait is 10s), so the queue holds.
+    srv = Server(_fn, variables, max_batch_size=64, max_wait_ms=10_000,
+                 max_queue=4, bucket_sizes=[64])
+    try:
+        futs = [srv.submit(x[i]) for i in range(4)]
+        with pytest.raises(QueueFullError) as ei:
+            srv.submit(x[4])
+        assert ei.value.retry_after_s > 0
+        assert srv.metrics.counters["serving.rejected_queue_full"] == 1
+        # graceful close drains the queued 4 as one final micro-batch
+        srv.close(drain=True)
+        eng = InferenceEngine(_fn, variables, device_batch_size=64)
+        ref = np.concatenate(list(eng.map_batches([x[:4]])), axis=0)
+        np.testing.assert_array_equal(
+            np.stack([np.asarray(f.result(timeout=60)) for f in futs]), ref)
+    finally:
+        srv.close()
+
+
+# -- fault isolation -------------------------------------------------------
+
+def test_bad_batch_fails_only_its_own_futures(setup):
+    """A model failure (here: a poison request shape the traced fn
+    rejects) must fail ONLY the batch it rode in; the next batch serves
+    normally."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=4, max_wait_ms=50,
+                bucket_sizes=[4]) as srv:
+        poison = np.zeros((13,), np.float32)  # fn expects 12 features
+        bad = [srv.submit(poison) for _ in range(4)]  # full batch -> flush
+        good = [srv.submit(x[i]) for i in range(4)]
+        for f in bad:
+            with pytest.raises(Exception):
+                f.result(timeout=60)
+        for f in good:
+            np.asarray(f.result(timeout=60))
+        assert srv.metrics.counters["serving.batch_failures"] == 1
+        assert srv.metrics.counters["serving.completed"] == 4
+
+
+def test_transient_failure_retried_through_utils_retry(setup, monkeypatch):
+    """max_retries wires the batch dispatch through utils.retry: a
+    transient (retryable) failure re-executes and the batch still
+    succeeds; deterministic failures stay non-retryable."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=4, max_wait_ms=20,
+                bucket_sizes=[4], max_retries=1) as srv:
+        calls = {"n": 0}
+        real_engine_for = srv._engine_for
+
+        class Flaky:
+            def __init__(self, eng):
+                self._eng = eng
+                self.device_batch_size = eng.device_batch_size
+
+            def __call__(self, batch):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("transient device hiccup")
+                return self._eng(batch)
+
+        monkeypatch.setattr(srv, "_engine_for",
+                            lambda b: Flaky(real_engine_for(b)))
+        futs = [srv.submit(x[i]) for i in range(4)]
+        for f in futs:
+            np.asarray(f.result(timeout=60))
+        assert calls["n"] == 2  # first attempt + one retry
+        assert srv.metrics.counters.get("serving.batch_failures", 0) == 0
+
+
+def test_stalled_batch_times_out_and_later_batches_proceed(setup,
+                                                           monkeypatch):
+    """A model call that stalls past dispatch_timeout_ms fails its OWN
+    batch with DispatchTimeoutError (the wedged worker is abandoned, its
+    concurrency slot freed) and the next batch still serves."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=2, max_wait_ms=50,
+                bucket_sizes=[2], dispatch_timeout_ms=300,
+                max_inflight_batches=1) as srv:
+        calls = {"n": 0}
+        real_engine_for = srv._engine_for
+
+        class Stalls:
+            def __init__(self, eng):
+                self._eng = eng
+                self.device_batch_size = eng.device_batch_size
+
+            def __call__(self, batch):
+                if not np.asarray(batch).any():
+                    # the server's untimed compile-warm probe (zeros):
+                    # never stall it — the watchdog scopes model calls
+                    return self._eng(batch)
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    time.sleep(2.0)  # well past the 300ms watchdog
+                return self._eng(batch)
+
+        monkeypatch.setattr(srv, "_engine_for",
+                            lambda b: Stalls(real_engine_for(b)))
+        stuck = [srv.submit(x[i]) for i in range(2)]
+        for f in stuck:
+            with pytest.raises(DispatchTimeoutError):
+                f.result(timeout=60)
+        ok = [srv.submit(x[i]) for i in range(2)]
+        for f in ok:
+            np.asarray(f.result(timeout=60))
+        assert srv.metrics.counters["serving.dispatch_timeouts"] == 1
+
+
+# -- lifecycle -------------------------------------------------------------
+
+def test_graceful_drain_serves_queue_then_rejects(setup):
+    variables, x = setup
+    srv = Server(_fn, variables, max_batch_size=64, max_wait_ms=10_000,
+                 bucket_sizes=[64])
+    futs = [srv.submit(x[i]) for i in range(5)]  # parked: batch never fills
+    srv.close(drain=True)
+    for f in futs:
+        np.asarray(f.result(timeout=60))  # drained, not dropped
+    with pytest.raises(ServerClosedError):
+        srv.submit(x[0])
+
+
+def test_abandoned_close_settles_undispatched_futures(setup, monkeypatch):
+    """A wedged model call with NO watchdog configured: close() must not
+    leave requests the dispatcher is holding (or still queued) pending
+    forever — everything outside the wedged batch itself settles with
+    ServerClosedError."""
+    variables, x = setup
+    srv = Server(_fn, variables, max_batch_size=2, max_wait_ms=20,
+                 bucket_sizes=[2], max_inflight_batches=1)
+    try:
+        real_engine_for = srv._engine_for
+        calls = {"n": 0}
+
+        class Wedge:
+            def __init__(self, eng):
+                self._eng = eng
+                self.device_batch_size = eng.device_batch_size
+
+            def __call__(self, batch):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    time.sleep(3.0)  # wedged well past close(timeout)
+                return self._eng(batch)
+
+        monkeypatch.setattr(srv, "_engine_for",
+                            lambda b: Wedge(real_engine_for(b)))
+        wedged = [srv.submit(x[i]) for i in range(2)]   # dispatches, hangs
+        parked = [srv.submit(x[i]) for i in range(2)]   # blocked behind it
+        time.sleep(0.2)  # let the wedged batch start
+        srv.close(drain=True, timeout_s=0.5)
+        for f in parked:
+            with pytest.raises(ServerClosedError):
+                f.result(timeout=10)
+        # the wedged batch itself settles once its model call returns
+        for f in wedged:
+            np.asarray(f.result(timeout=30))
+    finally:
+        srv.close()
+
+
+def test_hard_close_fails_queued_futures(setup):
+    variables, x = setup
+    srv = Server(_fn, variables, max_batch_size=64, max_wait_ms=10_000,
+                 bucket_sizes=[64])
+    futs = [srv.submit(x[i]) for i in range(3)]
+    srv.close(drain=False)
+    for f in futs:
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=60)
+
+
+def test_predict_and_predict_async(setup):
+    import asyncio
+
+    variables, x = setup
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    ref = np.concatenate(list(eng.map_batches([x[:4]])), axis=0)
+    with Server(_fn, variables, max_batch_size=8, max_wait_ms=5,
+                bucket_sizes=[8]) as srv:
+        np.testing.assert_array_equal(np.asarray(srv.predict(x[0])), ref[0])
+
+        async def handler():
+            rows = await asyncio.gather(
+                *[srv.predict_async(x[i]) for i in range(4)])
+            return np.stack([np.asarray(r) for r in rows])
+
+        np.testing.assert_array_equal(asyncio.run(handler()), ref)
+
+
+def test_warmup_compiles_every_bucket(setup):
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=16, max_wait_ms=5,
+                bucket_sizes=[8, 16]) as srv:
+        srv.warmup(x[0])
+        assert sorted(srv._engines) == [8, 16]
+
+
+# -- adapters --------------------------------------------------------------
+
+def test_from_transformer_model_transformer_parity(setup):
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.transformers.tensor import ModelTransformer
+
+    variables, x = setup
+    stage = ModelTransformer(
+        inputCol="features", outputCol="out",
+        modelFunction=ModelFunction(fn=_fn, variables=variables),
+        batchSize=16)
+    df = DataFrame({"features": [row for row in x]})
+    offline = stage.transform(df).column_to_numpy("out")
+    # bucket pinned to the stage's batch: bitwise identity is a per-shape
+    # contract (an 8-wide padded matmul may differ from a 16-wide one in
+    # the last ulp — same as any XLA re-fusion; see test_engine's allclose)
+    with from_transformer(stage, max_wait_ms=5, bucket_sizes=[16]) as srv:
+        assert srv.max_batch_size == 16  # stage batchSize seeds the server
+        online = np.stack(
+            [np.asarray(srv.predict(list(row))) for row in x])
+    np.testing.assert_array_equal(online.astype(np.float32), offline)
+
+
+def test_from_transformer_image_stage_accepts_structs_and_arrays(setup):
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.image.schema import imageArrayToStruct
+    from sparkdl_tpu.transformers.named_image import TFImageTransformer
+
+    rng = np.random.default_rng(5)
+
+    def img_fn(v, x):
+        import jax.numpy as jnp
+
+        return jnp.mean(jnp.asarray(x, jnp.float32), axis=(1, 2))
+
+    stage = TFImageTransformer(
+        inputCol="image", outputCol="vec",
+        modelFunction=ModelFunction(fn=img_fn, variables={}),
+        inputSize=[8, 8], batchSize=8)
+    rgb = (rng.random((8, 8, 3)) * 255).astype(np.uint8)
+    with from_transformer(stage, max_wait_ms=5) as srv:
+        via_array = np.asarray(srv.predict(rgb))
+        # struct requests decode through the same converter the offline
+        # path uses (structs store BGR byte order; the adapter flips back
+        # to the RGB the model sees)
+        struct = imageArrayToStruct(
+            np.ascontiguousarray(rgb[:, :, ::-1]), origin="r0")
+        via_struct = np.asarray(srv.predict(struct))
+        # a mis-sized array resizes host-side instead of failing
+        big = (rng.random((16, 16, 3)) * 255).astype(np.uint8)
+        resized = np.asarray(srv.predict(big))
+    np.testing.assert_array_equal(via_array, via_struct)
+    assert resized.shape == via_array.shape
+    np.testing.assert_allclose(via_array, rgb.mean(axis=(0, 1)), atol=0.5)
+
+
+def test_from_transformer_rejects_unknown_stage():
+    from sparkdl_tpu.transformers.base import Transformer
+
+    with pytest.raises(TypeError, match="from_transformer"):
+        from_transformer(Transformer())
+
+
+def test_register_serving_udf_shares_queue(setup):
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.udf.registry import UDFRegistry, register_serving_udf
+
+    variables, x = setup
+    reg = UDFRegistry()
+    df = DataFrame({"features": [list(row) for row in x[:9]] + [None]})
+    with Server(_fn, variables, max_batch_size=8, max_wait_ms=5,
+                bucket_sizes=[8],
+                host_preprocess=lambda v: np.asarray(v, np.float32)) as srv:
+        register_serving_udf("srv_udf", srv, registry=reg)
+        out = reg.apply("srv_udf", df, "features", "scored")
+        rows = out.table.column("scored").to_pylist()
+    eng = InferenceEngine(_fn, variables, device_batch_size=8)
+    ref = np.concatenate(list(eng.map_batches([x[:9]])), axis=0)
+    assert rows[-1] is None  # null row stays null
+    np.testing.assert_allclose(np.asarray(rows[:9], np.float32), ref,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_client_cancel_never_kills_the_dispatcher(setup):
+    """A client cancel() racing deadline shedding must not raise out of
+    the dispatcher thread: the cancelled future is skipped and the server
+    keeps serving."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=4, max_wait_ms=30,
+                bucket_sizes=[4]) as srv:
+        doomed = srv.submit(x[0], timeout_ms=0)
+        assert doomed.cancel()  # pending future: cancel wins the race
+        live = srv.submit(x[1])
+        np.asarray(live.result(timeout=60))
+        # dispatcher survived the InvalidStateError window: still serving
+        np.asarray(srv.predict(x[2]))
+
+
+def test_named_model_honors_zoo_compute_dtype(monkeypatch):
+    """Server('<zoo name>') must follow the zoo transformers'
+    SPARKDL_ZOO_COMPUTE_DTYPE contract (bf16 compute + f32 host cast
+    under the bench configuration) so from_transformer keeps its
+    same-rows-as-transform() promise."""
+    import jax.numpy as jnp
+
+    import sparkdl_tpu.models as models
+    import sparkdl_tpu.transformers.named_image as named_image
+    from sparkdl_tpu.serving import server as server_mod
+
+    class _Spec:
+        preprocess = staticmethod(lambda x: x)
+
+    class _Mod:
+        def apply(self, v, x, train=False, features=False):
+            return x
+
+    monkeypatch.setattr(models, "get_model_spec", lambda n: _Spec())
+    monkeypatch.setattr(named_image, "_cached_model", lambda n: (_Mod(), {}))
+    monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bfloat16")
+    _, _, ov = server_mod._resolve_model("FakeZoo", None, True)
+    assert ov["compute_dtype"] == jnp.bfloat16
+    assert ov["output_host_dtype"] == np.float32
+    monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "float32")
+    _, _, ov = server_mod._resolve_model("FakeZoo", None, True)
+    assert ov == {}
+    monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bogus")
+    with pytest.raises(ValueError, match="not supported"):
+        server_mod._resolve_model("FakeZoo", None, True)
+
+
+def test_result_rows_do_not_pin_batch_output(setup):
+    """Each future's result must be its own O(row) array, not a view
+    pinning the whole [bucket, ...] batch output."""
+    variables, x = setup
+    with Server(_fn, variables, max_batch_size=8, max_wait_ms=5,
+                bucket_sizes=[8]) as srv:
+        row = np.asarray(srv.predict(x[0]))
+    assert row.base is None  # owns its memory
+
+
+# -- construction errors ---------------------------------------------------
+
+def test_register_serving_udf_overrides_online_deadline(setup):
+    """Bulk offline rows must NOT inherit the server's online
+    default_timeout_ms: queue-tail rows would be shed and one
+    DeadlineExceededError would fail the whole column apply."""
+    from sparkdl_tpu.frame import DataFrame
+    from sparkdl_tpu.udf.registry import UDFRegistry, register_serving_udf
+
+    variables, x = setup
+    reg = UDFRegistry()
+    df = DataFrame({"features": [list(row) for row in x]})
+    # tiny batches + an aggressive online deadline: 45 queued rows take
+    # many dispatch cycles, far beyond 1ms in-queue for the tail
+    with Server(_fn, variables, max_batch_size=8, max_wait_ms=5,
+                bucket_sizes=[8], default_timeout_ms=1) as srv:
+        register_serving_udf("bulk", srv, registry=reg)
+        out = reg.apply("bulk", df, "features", "scored")
+        rows = out.table.column("scored").to_pylist()
+    assert all(r is not None for r in rows)
+    assert srv.metrics.counters.get("serving.shed_deadline", 0) == 0
+
+
+def test_server_rejects_bad_buckets(setup):
+    variables, _ = setup
+    with pytest.raises(ValueError, match="cover"):
+        Server(_fn, variables, max_batch_size=16, bucket_sizes=[4, 8])
+    with pytest.raises(ValueError, match="positive"):
+        Server(_fn, variables, bucket_sizes=[0])
+
+
+def test_server_rejects_unknown_model_form():
+    with pytest.raises(TypeError, match="Cannot serve"):
+        Server(12345)

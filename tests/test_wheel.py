@@ -75,6 +75,9 @@ assert batch.shape == (2, 8, 8, 3) and list(ok) == [True, False]
 # native layer degrades gracefully (callable either way)
 import sparkdl_tpu.native as native
 assert native.native_available() in (True, False)
+
+# the serving subsystem ships and imports without initializing jax
+from sparkdl_tpu.serving import Server, from_transformer  # noqa: F401
 print("WHEEL-SMOKE-OK")
 """
     env = {k: v for k, v in os.environ.items()
