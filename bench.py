@@ -35,17 +35,27 @@ transfers — D2H ~1-6 MB/s, ~120 ms dispatch round trip — and the 1-vCPU
 host; PERF.md) carry a self-describing ``env_bound`` marker.
 
 Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default
-"1,1e2e,2,3,4,5,serving" — headline first so a timed-out run still
-printed it; it is re-emitted last on completion), SPARKDL_BENCH_BATCH
-(128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE (bfloat16|float32),
-SPARKDL_BENCH_SERVING_REQUESTS (512).
+"1,1e2e,2,3,4,5,serving,pipeline" — headline first so a timed-out run
+still printed it; it is re-emitted last on completion),
+SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
+(bfloat16|float32), SPARKDL_BENCH_SERVING_REQUESTS (512),
+SPARKDL_BENCH_REPROBE_TIMEOUT (120), SPARKDL_RELAY_CACHE (last-good
+relay profile path).
 
-The "serving" config measures the online layer (sparkdl_tpu.serving):
-dynamic-batching throughput plus p50/p99 request latency on a synthetic
-model, in a subprocess; when the relay probe declares the device
-unreachable it is the ONE config that still runs, pinned to host CPU
-(the serving envelope is host orchestration + XLA compute, so the
-fallback still exercises the whole stack end-to-end).
+Dead-relay behavior: a failed start-of-run probe no longer blanks the
+whole run — the chip-independent configs run FIRST (their lines are
+guaranteed before any re-probe wait), the relay is RE-PROBED before
+each device config (mid-session recoveries salvage whatever remains;
+budgeted by SPARKDL_BENCH_MAX_REPROBES consecutive failures so a fully
+dead relay costs minutes, not the driver window), every dead-relay
+error record carries the last SUCCESSFUL probe's numbers with a
+staleness timestamp (small on-disk cache), and two configs are
+chip-independent by design: "serving" (dynamic-batching throughput + p50/p99 latency on
+a synthetic model — host orchestration + XLA compute, pinned to host
+CPU on fallback) and "pipeline" (the host/device overlap proof on a
+synthetic sleep device, always CPU).  Per-config lines that drive the
+streaming engine also carry the pipeline stage-stall ledger
+(``pipeline_stages``) so host-vs-device boundedness is visible per run.
 """
 
 from __future__ import annotations
@@ -203,6 +213,47 @@ def _run_json_subprocess(code: str, timeout_s: int, env=None):
     return json.loads(lines[-1])
 
 
+# Last-good relay profile cache: when a probe fails, the error record
+# still carries the most recent SUCCESSFUL probe's numbers with their
+# staleness timestamp, so a dead-relay run's JSON is interpretable
+# without digging through old BENCH_r*.json files.
+RELAY_CACHE_PATH = os.environ.get(
+    "SPARKDL_RELAY_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "artifacts", "relay_last_good.json"))
+
+
+def _save_last_good_relay(profile) -> None:
+    try:
+        rec = {k: v for k, v in dict(profile).items() if k != "ts"}
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        os.makedirs(os.path.dirname(RELAY_CACHE_PATH), exist_ok=True)
+        with open(RELAY_CACHE_PATH, "w") as f:
+            json.dump(rec, f)
+    except OSError:
+        pass  # a read-only checkout must not fail the bench
+
+
+def _load_last_good_relay():
+    try:
+        with open(RELAY_CACHE_PATH) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) and rec.get("ts") else None
+    except (OSError, ValueError):
+        return None
+
+
+def _dead_relay_record(config: str, msg: str) -> dict:
+    """Error record for a config blanked by a dead relay; carries the
+    last successful probe's profile (with its staleness ``ts``) when one
+    is cached."""
+    rec = {"config": config, "error": msg}
+    last_good = _load_last_good_relay()
+    if last_good:
+        rec["last_good_relay"] = last_good
+    return rec
+
+
 def measure_relay_profile(timeout_s: int = 240):
     """Per-round relay facts: H2D/D2H effective bandwidth + dispatch round
     trip.  The relay's profile has flipped between rounds (round 3: H2D
@@ -329,6 +380,8 @@ def bench_config1_e2e():
     """The user path: JPEG bytes -> decode+resize -> streaming featurize."""
     from sparkdl_tpu.image.io import decodeResizeBatch
     from sparkdl_tpu.parallel.engine import InferenceEngine
+    from sparkdl_tpu.parallel.pipeline import (pipeline_enabled_from_env,
+                                               pipeline_stage_summary)
     from sparkdl_tpu.utils.prefetch import prefetch_iter
 
     fn, variables, (h, w) = _zoo_fn("InceptionV3", featurize=True)
@@ -347,8 +400,12 @@ def bench_config1_e2e():
     # warm the compile so e2e measures steady state, not compilation
     w0, _ = decodeResizeBatch(blobs[:eng.device_batch_size], h, w)
     list(eng.map_batches([w0]))
+    # the pipelined engine's prepare thread pulls the decode iterator
+    # itself; prefetch_iter is only needed on the serial escape hatch
+    feed = (chunks() if pipeline_enabled_from_env()
+            else prefetch_iter(chunks(), depth=2))
     t0 = time.perf_counter()
-    outs = list(eng.map_batches(prefetch_iter(chunks(), depth=2)))
+    outs = list(eng.map_batches(feed))
     elapsed = time.perf_counter() - t0
     rows = sum(o.shape[0] for o in outs)
     assert rows == n
@@ -357,7 +414,8 @@ def bench_config1_e2e():
          ips, "images/sec/chip", baseline_model="InceptionV3",
          env_bound=_relay_tag() + "+1vcpu-host (PERF.md: feature gather "
                    "+ single-core decode bound, not chip- or "
-                   "framework-bound)")
+                   "framework-bound)",
+         extra={"pipeline_stages": pipeline_stage_summary(eng.metrics)})
 
 
 def bench_config2():
@@ -585,6 +643,43 @@ def bench_serving():
          })
 
 
+# Synthetic-device pipeline bench child: the overlap proof without the
+# chip.  Always pinned to host CPU — the "device" is a deterministic
+# sleep standing in for the relay's blocking ~100 ms dispatch round trip
+# — so it measures the pipeline layer itself and runs even when the
+# relay is dead (like "serving", it is chip-independent by design).
+_PIPELINE_BENCH = r"""
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.parallel.pipeline import synthetic_overlap_benchmark
+print(json.dumps(synthetic_overlap_benchmark()))
+"""
+
+
+def bench_pipeline():
+    """Pipelined host/device overlap on the synthetic slow device:
+    speedup vs the serial path (SPARKDL_PIPELINE=0 equivalent) plus the
+    per-stage stall/occupancy ledger.  The tier-1 contract
+    (tests/test_pipeline.py) asserts >= 1.5x on this same benchmark."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    prof = _run_json_subprocess(_PIPELINE_BENCH, timeout_s=480, env=env)
+    emit("pipeline",
+         "pipelined host/device overlap speedup (synthetic slow device)",
+         prof["speedup"], "x vs serial path",
+         env_bound="synthetic: deterministic sleep device on host CPU "
+                   "(measures the pipeline layer, not the chip)",
+         extra={
+             "serial_s": round(float(prof["serial_s"]), 3),
+             "pipelined_s": round(float(prof["pipelined_s"]), 3),
+             "dispatch_ms": prof["dispatch_ms"],
+             "prepare_ms": prof["prepare_ms"],
+             "n_batches": prof["n_batches"],
+             "pipeline_stages": prof["stages"],
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -593,7 +688,21 @@ BENCHES = {
     "4": bench_config4,
     "5": bench_config5,
     "serving": bench_serving,
+    "pipeline": bench_pipeline,
 }
+
+
+# Configs that never need the chip: "serving" runs on its CPU fallback
+# (it measures the serving envelope — queue/batching/dispatch) and
+# "pipeline" simulates its device with a deterministic sleep.
+_CHIPLESS_CONFIGS = ("serving", "pipeline")
+
+REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
+                                       "120"))
+# Consecutive failed mid-run re-probes before the remaining device
+# configs skip instantly (bounds a fully-dead relay's added wait to
+# ~MAX_REPROBES x REPROBE_TIMEOUT_S instead of one timeout per config).
+MAX_REPROBES = int(os.environ.get("SPARKDL_BENCH_MAX_REPROBES", "3"))
 
 
 def main():
@@ -606,6 +715,7 @@ def main():
     relay_dead = False
     try:
         RELAY.update(measure_relay_profile())
+        _save_last_good_relay(RELAY)
         _print_line(json.dumps({"config": "relay", **RELAY}))
     except subprocess.TimeoutExpired:
         # One retry with a longer window, then declare the device
@@ -614,13 +724,14 @@ def main():
         # leaves no diagnostics.  Explicit skip lines beat silence.
         try:
             RELAY.update(measure_relay_profile(timeout_s=480))
+            _save_last_good_relay(RELAY)
             _print_line(json.dumps({"config": "relay", **RELAY}))
         except subprocess.TimeoutExpired as e:
             relay_dead = True
-            _print_line(json.dumps({
-                "config": "relay",
-                "error": f"device unreachable: probe timed out twice "
-                         f"({repr(e)[:120]})"}))
+            _print_line(json.dumps(_dead_relay_record(
+                "relay",
+                f"device unreachable: probe timed out twice "
+                f"({repr(e)[:120]})")))
         except Exception as e:
             # a non-timeout retry failure means the device answered —
             # diagnostics only, configs still run (first-attempt policy)
@@ -629,21 +740,50 @@ def main():
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
-    default = "1,1e2e,2,3,4,5,serving"
-    wanted = os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")
-    for key in wanted:
-        key = key.strip()
+    default = "1,1e2e,2,3,4,5,serving,pipeline"
+    keys = [k.strip() for k in
+            os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
+    if relay_dead:
+        # Chip-independent configs FIRST on a dead relay: their lines are
+        # guaranteed, and the bounded re-probe waits below then only
+        # delay configs that need the chip anyway (a driver-side suite
+        # timeout must never eat the only measurable configs).
+        keys.sort(key=lambda k: k not in _CHIPLESS_CONFIGS)  # stable
+    failed_reprobes = 0
+    for key in keys:
         fn = BENCHES.get(key)
         if fn is None:
             continue
-        if relay_dead and key != "serving":
-            # "serving" still runs on its CPU fallback: it measures the
-            # serving envelope (queue/batching/dispatch), not the chip.
-            _print_line(json.dumps({
-                "config": key,
-                "error": "skipped: device relay unreachable at bench "
-                         "start (see 'relay' line)"}))
-            continue
+        if relay_dead and key not in _CHIPLESS_CONFIGS:
+            # RE-PROBE between configs rather than blanking the rest of
+            # the run on one dead start-of-run probe: relay outages have
+            # recovered mid-session before (round 5), and every salvaged
+            # config is a measured number the round otherwise loses.
+            # Budgeted: after MAX_REPROBES consecutive failures the
+            # remaining device configs skip instantly, so a dead relay
+            # costs minutes, not the whole driver window.
+            if failed_reprobes >= MAX_REPROBES:
+                _print_line(json.dumps(_dead_relay_record(
+                    key,
+                    "skipped: device relay unreachable at bench time "
+                    f"(re-probe budget of {MAX_REPROBES} exhausted; see "
+                    "'relay' line)")))
+                continue
+            try:
+                RELAY.update(measure_relay_profile(
+                    timeout_s=REPROBE_TIMEOUT_S))
+                _save_last_good_relay(RELAY)
+                relay_dead = False
+                _RELAY_DEAD[0] = False
+                _print_line(json.dumps({"config": "relay",
+                                        "recovered": True, **RELAY}))
+            except Exception:
+                failed_reprobes += 1
+                _print_line(json.dumps(_dead_relay_record(
+                    key,
+                    "skipped: device relay unreachable at bench time "
+                    "(re-probed before this config; see 'relay' line)")))
+                continue
         try:
             fn()
         except Exception as e:  # one failing config must not kill the rest

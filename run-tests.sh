@@ -9,6 +9,14 @@
 # suite (tests/test_serving.py) is CPU-only and carries no slow marks, so
 # the online path sits inside the tier-1 gate by construction — the check
 # below keeps that wiring from silently regressing if the file moves.
+# Likewise tests/test_pipeline.py carries the pipelined-execution overlap
+# contract (synthetic 100 ms slow device on the CPU backend, >= 1.5x vs
+# SPARKDL_PIPELINE=0, bit-identical outputs): fast, chip-free, tier-1.
+#
+# Hardware A/Bs that need the real chip live OUTSIDE this gate:
+# tools/run_pending_abs.sh runs the gated levers (ResNet fused shortcut,
+# MNv2 fused tail, batches_per_dispatch on configs 3/4) whenever the
+# relay is alive at bench time.
 #
 # Usage: ./run-tests.sh [extra pytest args]
 set -euo pipefail
@@ -16,6 +24,11 @@ cd "$(dirname "$0")"
 if [[ ! -f tests/test_serving.py ]]; then
   echo "FATAL: tests/test_serving.py missing — the serving subsystem" \
        "would ship untested" >&2
+  exit 1
+fi
+if [[ ! -f tests/test_pipeline.py ]]; then
+  echo "FATAL: tests/test_pipeline.py missing — the pipelined execution" \
+       "layer's overlap + parity contract would ship unasserted" >&2
   exit 1
 fi
 exec python -m pytest tests/ -q --durations=10 "$@"

@@ -162,6 +162,36 @@ def _passthrough_source(vals):
     return src
 
 
+def _promote_schema(schema: Optional[pa.Schema],
+                    t: pa.Table) -> pa.Schema:
+    """Widen the running ``schema`` with ``t``'s (null -> concrete,
+    int -> float, ...) — the shared promotion rule of the batch-wise
+    mappers.  Inferring each batch independently and unifying is what
+    keeps a later float batch from being silently TRUNCATED against an
+    int-pinned first batch (``from_pylist(schema=...)`` coerces 3.5 -> 3
+    without raising)."""
+    if schema is None:
+        return t.schema
+    if t.schema != schema:
+        return pa.unify_schemas([schema, t.schema],
+                                promote_options="permissive")
+    return schema
+
+
+def _concat_conforming(tables: List[pa.Table], schema: pa.Schema) -> pa.Table:
+    """Concat per-batch tables under the unified ``schema``: a batch may
+    lack a column some other batch produced — null-fill it (the pinned-
+    schema behavior) before the ordered cast."""
+    def conform(t: pa.Table) -> pa.Table:
+        for field in schema:
+            if field.name not in t.column_names:
+                t = t.append_column(field.name,
+                                    pa.nulls(len(t), field.type))
+        return t.select([f.name for f in schema]).cast(schema)
+
+    return pa.concat_tables([conform(t) for t in tables])
+
+
 def _to_table(data) -> pa.Table:
     if isinstance(data, pa.Table):
         return data
@@ -229,15 +259,25 @@ class DataFrame:
 
     def withColumn(self, name: str, values) -> "DataFrame":
         """Append/replace a column.  ``values`` may be a pyarrow Array /
-        ChunkedArray, numpy array, or Python list."""
+        ChunkedArray, numpy array (any rank: rank 2 becomes a
+        ``list<leaf dtype>`` column, rank>=3 nests ``fixed_size_list``
+        per trailing dim, leaf dtype preserved), or Python list."""
         if isinstance(values, (pa.Array, pa.ChunkedArray)):
             arr = values
         elif isinstance(values, np.ndarray):
             if values.ndim == 1:
                 arr = pa.array(values)
-            else:
-                # rank>1 numpy -> fixed-size-list-of-... column
+            elif values.ndim == 2:
+                # list-of-leaf-dtype column (rows stay 1-D arrays, so
+                # pyarrow keeps the numpy leaf dtype)
                 arr = pa.array(list(values))
+            else:
+                # rank>=3: pa.array refuses >1-D elements — build nested
+                # fixed_size_list layers over the flattened values buffer
+                # (leaf dtype preserved, no per-row Python round trip)
+                arr = pa.array(np.ascontiguousarray(values).reshape(-1))
+                for dim in reversed(values.shape[1:]):
+                    arr = pa.FixedSizeListArray.from_arrays(arr, int(dim))
         else:
             arr = pa.array(values)
         if isinstance(arr, pa.ChunkedArray):
@@ -385,9 +425,12 @@ class DataFrame:
         The vectorized counterpart of the reference's TensorFrames
         ``map_blocks`` executor path (``tensorframes.map_blocks`` —
         SURVEY.md §2 C11 ``blocked=True``): no per-row Python objects —
-        ``fn`` works on columnar data.  The first output batch pins the
-        schema."""
-        out: List[pa.RecordBatch] = []
+        ``fn`` works on columnar data.  Per-output-batch schemas are
+        PROMOTED (null -> concrete, int -> float, missing column ->
+        null-filled) exactly like ``map_rows`` — a later batch whose fn
+        output widens a column must widen the frame, not raise (or
+        truncate) against a schema pinned by the first batch."""
+        out: List[pa.Table] = []
         schema: Optional[pa.Schema] = None
         for rb in self.iter_batches(batch_size):
             res = fn(rb)
@@ -395,12 +438,12 @@ class DataFrame:
                 raise TypeError(
                     f"map_blocks fn must return a pyarrow.RecordBatch, got "
                     f"{type(res).__name__}")
-            if schema is None:
-                schema = res.schema
-            out.append(res)
+            t = pa.Table.from_batches([res])
+            schema = _promote_schema(schema, t)
+            out.append(t)
         if schema is None:
             return DataFrame.from_rows([])
-        return DataFrame(pa.Table.from_batches(out, schema=schema))
+        return DataFrame(_concat_conforming(out, schema))
 
     def map_rows(self, fn: Callable[[Row], dict],
                  batch_size: int = 1024,
@@ -468,21 +511,8 @@ class DataFrame:
             else:
                 t = pa.table(list(pass_cols.values()),
                              names=list(pass_cols))
-            if schema is None:
-                schema = t.schema
-            elif t.schema != schema:
-                schema = pa.unify_schemas([schema, t.schema],
-                                          promote_options="permissive")
+            schema = _promote_schema(schema, t)
             out_tables.append(t)
         if schema is None:
             return DataFrame.from_rows([])
-
-        def _conform(t: pa.Table) -> pa.Table:
-            # A batch may lack a key some other batch produced: null-fill it
-            # (the old pinned-schema behavior) before the ordered cast.
-            for field in schema:
-                if field.name not in t.column_names:
-                    t = t.append_column(field.name, pa.nulls(len(t), field.type))
-            return t.select([f.name for f in schema]).cast(schema)
-
-        return DataFrame(pa.concat_tables([_conform(t) for t in out_tables]))
+        return DataFrame(_concat_conforming(out_tables, schema))

@@ -10,12 +10,16 @@ instead of Spark shuffle/broadcast.
 from sparkdl_tpu.parallel.mesh import (batch_sharding, get_mesh,
                                        replicated_sharding)
 from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
+                                           pipeline_enabled_from_env)
 from sparkdl_tpu.parallel import distributed
 
 __all__ = [
     "InferenceEngine",
+    "PipelinedRunner",
     "batch_sharding",
     "distributed",
     "get_mesh",
+    "pipeline_enabled_from_env",
     "replicated_sharding",
 ]

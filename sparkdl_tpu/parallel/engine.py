@@ -15,7 +15,12 @@ Throughput design:
   * dispatch is async with a bounded in-flight window (double buffering):
     the next batch's host->device transfer overlaps the current batch's
     compute, while device residency stays O(window x batch) regardless of
-    input size (both ``map_batches`` and ``__call__``).
+    input size (both ``map_batches`` and ``__call__``);
+  * host stages overlap the device by default: prepare (decode/pack/pad),
+    H2D+dispatch, and D2H gather run on worker threads with backpressure
+    queues (``parallel.pipeline.PipelinedRunner``; ``SPARKDL_PIPELINE=0``
+    restores the serial path) — batch k+1 decodes while batch k computes
+    and batch k-1 gathers, bit-identically to the serial path.
 """
 
 from __future__ import annotations
@@ -25,6 +30,8 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 import numpy as np
 
 from sparkdl_tpu.parallel import mesh as mesh_lib
+from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
+                                           pipeline_enabled_from_env)
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 
@@ -268,14 +275,30 @@ class InferenceEngine:
         return jax.tree_util.tree_map(lambda a: a[off:off + size], batch)
 
     # -- whole-array API ---------------------------------------------------
-    def __call__(self, batch, window: int = 2):
+    def __call__(self, batch, window: int = 2,
+                 pipeline: Optional[bool] = None):
         """Process a full batch (array or pytree); returns host output with
         matching row count.
 
+        Host-memory contract: the pipelined path (``pipeline=True``, the
+        ``SPARKDL_PIPELINE`` default) PREALLOCATES the output — the leaf
+        output shape is fixed by the single compiled program, so after the
+        first gathered chunk the full ``[n, ...]`` result buffer is
+        allocated once and every later chunk is copied into it and
+        released.  Peak host residency is therefore the output itself plus
+        O(window + depth) chunks, never a second whole-output's worth of
+        accumulated parts (the serial path concatenates a per-chunk list,
+        which transiently doubles the output footprint).  Either way the
+        OUTPUT still materializes in host RAM — route multi-million-row
+        frames through ``map_batches`` streaming instead.
+
         Chunks run through the same bounded in-flight window as
         ``map_batches`` (chunk k+1 transfers/computes while chunk k is
-        gathered), so device residency is O(window x device_batch) even for
-        huge inputs; only the gathered host outputs accumulate.
+        gathered), so device residency is O(window x device_batch) even
+        for huge inputs.  Pipelined outputs are bit-identical to serial
+        ones (same programs, same pad/trim, same order); inputs that fit
+        one device batch skip the worker threads entirely — nothing to
+        overlap — so serving-sized calls pay no thread latency.
         """
         import time
 
@@ -285,33 +308,63 @@ class InferenceEngine:
         n = self._leaves(batch)
         if n == 0:
             raise ValueError("Empty input batch")
+        use_pipe = (pipeline_enabled_from_env() if pipeline is None
+                    else bool(pipeline))
         t0 = time.perf_counter()
-        outs = list(self.map_batches([batch], window=window))
+        if not use_pipe or n <= self.device_batch_size:
+            outs = list(self.map_batches([batch], window=window,
+                                         pipeline=False))
+            result = jax.tree_util.tree_map(
+                lambda *parts: np.concatenate(parts, axis=0), *outs)
+        else:
+            out = None
+            off = 0
+            for part in self.map_batches([batch], window=window,
+                                         pipeline=True):
+                k = self._leaves(part)
+                if out is None:
+                    # leaf trailing shapes are fixed by the one compiled
+                    # program: preallocate [n, ...] per leaf and stream
+                    # chunks straight in
+                    out = jax.tree_util.tree_map(
+                        lambda a: np.empty((n,) + a.shape[1:], a.dtype),
+                        part)
+                    self.metrics.incr("engine_call_prealloc")
+                for dst, src in zip(jax.tree_util.tree_leaves(out),
+                                    jax.tree_util.tree_leaves(part)):
+                    dst[off:off + k] = src
+                off += k
+            result = out
         elapsed = time.perf_counter() - t0
         self.metrics.incr("items", n)
         self.metrics.record_time("engine_call", elapsed)
-        return jax.tree_util.tree_map(
-            lambda *parts: np.concatenate(parts, axis=0), *outs)
+        return result
 
-    def _run_group(self, pieces):
-        """Dispatch exactly ``batches_per_dispatch`` ``pieces`` as ONE
-        stacked lax.map program; returns (true_row_counts, device_out)."""
+    def _stack_group(self, pieces):
+        """Host half of a grouped dispatch: pad each of the
+        ``batches_per_dispatch`` ``pieces`` and stack them on a leading
+        group axis; returns (true_row_counts, stacked_host_batch)."""
         import jax
 
         ns = tuple(self._leaves(p) for p in pieces)
         stacked = jax.tree_util.tree_map(
             lambda *parts: np.stack(parts, axis=0),
             *[self._pad(p) for p in pieces])
+        return ns, stacked
+
+    def _dispatch_group(self, stacked):
+        """Device half of a grouped dispatch: H2D transfer + ONE stacked
+        lax.map launch; returns the device output."""
+        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sh = NamedSharding(self.mesh, P(None, mesh_lib.DATA_AXIS))
-        out = self._compiled_group(self.variables,
-                                   jax.device_put(stacked, sh))
-        return ns, out
+        return self._compiled_group(self.variables,
+                                    jax.device_put(stacked, sh))
 
     # -- streaming API -----------------------------------------------------
-    def map_batches(self, batches: Iterable[Any],
-                    window: int = 2) -> Iterator[Any]:
+    def map_batches(self, batches: Iterable[Any], window: int = 2,
+                    pipeline: Optional[bool] = None) -> Iterator[Any]:
         """Map over an iterator of host batches with a bounded in-flight
         window (double buffering by default): batch k+1 transfers/computes
         while batch k is gathered.  With ``batches_per_dispatch`` = k > 1
@@ -321,7 +374,53 @@ class InferenceEngine:
         O(window x device_batch) in HOST-BATCH terms instead of growing
         ~k-fold with the dispatch grouping.  A ragged tail group runs its
         pieces through the plain per-batch program instead of padding
-        with whole zero batches."""
+        with whole zero batches.
+
+        ``pipeline`` (default: the ``SPARKDL_PIPELINE`` env knob, ON)
+        runs host prepare, H2D+dispatch, and D2H gather on overlapping
+        worker threads (:class:`~sparkdl_tpu.parallel.pipeline.
+        PipelinedRunner`): the input iterator — typically the decode
+        stage — is pulled on its own thread while the device computes and
+        a third thread gathers, with the same bounded window and
+        BIT-IDENTICAL outputs.  ``pipeline=False`` (or
+        ``SPARKDL_PIPELINE=0``) keeps everything on the calling thread."""
+        use_pipe = (pipeline_enabled_from_env() if pipeline is None
+                    else bool(pipeline))
+        if use_pipe:
+            return PipelinedRunner(self, window=window).run(batches)
+        return self._map_batches_serial(batches, window)
+
+    def _iter_pieces(self, batches: Iterable[Any]) -> Iterator[tuple]:
+        """THE host-prepare sequence, shared verbatim by the serial path
+        and the pipelined runner's prepare stage (so their dispatch order
+        is identical by construction): slice chunks into device-batch
+        pieces and pad them, stacking full ``batches_per_dispatch``
+        groups; yields ``("plain", n_rows, padded_piece)`` /
+        ``("group", n_rows_tuple, stacked_group)`` in dispatch order.
+        The ragged tail group runs its pieces through the plain per-batch
+        program instead of padding with whole zero batches."""
+        import jax
+
+        group: list = []
+        for chunk in batches:
+            chunk = jax.tree_util.tree_map(np.asarray, chunk)
+            n = self._leaves(chunk)
+            for off in range(0, n, self.device_batch_size):
+                piece = self._slice(chunk, off, self.device_batch_size)
+                if self.batches_per_dispatch == 1:
+                    yield ("plain", self._leaves(piece), self._pad(piece))
+                else:
+                    group.append(piece)
+                    if len(group) == self.batches_per_dispatch:
+                        yield ("group",) + self._stack_group(group)
+                        group = []
+        for piece in group:  # ragged tail: plain program, no zero batches
+            yield ("plain", self._leaves(piece), self._pad(piece))
+
+    def _map_batches_serial(self, batches: Iterable[Any],
+                            window: int = 2) -> Iterator[Any]:
+        """The single-threaded path (``SPARKDL_PIPELINE=0``): identical
+        piece order and programs, no worker threads."""
         from collections import deque
 
         import jax
@@ -344,25 +443,10 @@ class InferenceEngine:
                     yield self._trim(
                         jax.tree_util.tree_map(lambda a: a[i], host), n)
 
-        group: list = []
-        for chunk in batches:
-            chunk = jax.tree_util.tree_map(np.asarray, chunk)
-            n = self._leaves(chunk)
-            for off in range(0, n, self.device_batch_size):
-                piece = self._slice(chunk, off, self.device_batch_size)
-                if self.batches_per_dispatch == 1:
-                    inflight.append((self._leaves(piece),
-                                     self.run_padded(self._pad(piece))))
-                    yield from drain(window)
-                else:
-                    group.append(piece)
-                    if len(group) == self.batches_per_dispatch:
-                        inflight.append(self._run_group(group))
-                        group = []
-                        yield from drain(window)
-        for piece in group:  # ragged tail: plain program, no zero batches
-            inflight.append((self._leaves(piece),
-                             self.run_padded(self._pad(piece))))
+        for kind, ns, host in self._iter_pieces(batches):
+            inflight.append((ns, self.run_padded(host) if kind == "plain"
+                             else self._dispatch_group(host)))
+            yield from drain(window)
         yield from drain(0)
 
     @property

@@ -412,6 +412,12 @@ class Server:
                                      now - r.enqueued_at)
         bucket = self._bucket_for(n)
         eng = self._engine_for(bucket)
+        # Dispatch rides the same engine entrypoint as the offline stack
+        # (parallel.pipeline): a micro-batch is a single device batch, so
+        # the engine's single-piece fast path applies (no thread hop on
+        # the latency path) and the online H2D/compute/gather overlap
+        # comes from running up to max_inflight_batches of these worker
+        # threads concurrently over jax's async dispatch.
         stacked = jax.tree_util.tree_map(
             lambda *rows: np.stack(rows, axis=0),
             *[r.payload for r in requests])
@@ -459,9 +465,11 @@ class Server:
 
     def stats(self) -> Dict[str, float]:
         """Snapshot of the serving metrics (counters, gauges, latency
-        p50/p99 — see ``utils.metrics.Metrics.summary``)."""
-        return {k: v for k, v in self.metrics.summary().items()
-                if k.startswith("serving.") or k.startswith("engine_")}
+        p50/p99 — see ``utils.metrics.Metrics.summary``), plus any
+        ``pipeline.*`` stage metrics the shared engines recorded."""
+        m = self.metrics
+        return {**m.subset("serving."), **m.subset("engine_"),
+                **m.subset("pipeline.")}
 
     def close(self, drain: bool = True,
               timeout_s: Optional[float] = 30.0) -> None:

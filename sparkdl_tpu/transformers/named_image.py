@@ -179,16 +179,25 @@ class _ImageInputStage(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
         nothing when no row decodes.  The engine (weights + compile) is
         only built once the first decoded chunk proves there is work to
         do.  Consumers that pack outputs incrementally (image mode) keep
-        peak host residency at O(chunk), not O(dataset)."""
-        from itertools import chain
+        peak host residency at O(chunk), not O(dataset).
 
-        from sparkdl_tpu.utils.prefetch import prefetch_iter
+        Decode/compute overlap: under the default pipelined engine
+        (``SPARKDL_PIPELINE``) the runner's own prepare thread pulls the
+        decode iterator while the device computes and a gather thread
+        fetches — wrapping the decode in ``prefetch_iter`` too would only
+        add a queue hop, so the explicit prefetch is reserved for the
+        serial escape hatch."""
+        from itertools import chain
 
         import time
 
+        from sparkdl_tpu.parallel.pipeline import pipeline_enabled_from_env
+        from sparkdl_tpu.utils.prefetch import prefetch_iter
+
         chunks = self._decoded_chunks(
             dataset, height, width, self._chunk_rows(), valid_idx, origins)
-        it = prefetch_iter(chunks, depth=2)
+        it = (iter(chunks) if pipeline_enabled_from_env()
+              else prefetch_iter(chunks, depth=2))
         first = next(it, None)
         if first is None:
             return

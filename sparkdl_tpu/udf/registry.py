@@ -147,7 +147,10 @@ def register_image_udf(name: str, model_function, *,
 
     Pipeline per call: decode/resize image structs on the host (null rows
     stay null) -> [optional jax ``preprocessor``] ∘ model in one jit program
-    on the mesh.
+    on the mesh.  Scoring rides the engine's pipelined execution path
+    (``SPARKDL_PIPELINE``): a multi-batch column overlaps H2D, compute,
+    and gather across chunks, and the output matrix is preallocated and
+    streamed into rather than accumulated per chunk.
     """
     from sparkdl_tpu.graph.function import ModelFunction
     from sparkdl_tpu.image.io import arrowStructsToBatch, structsToBatch
@@ -172,6 +175,8 @@ def register_image_udf(name: str, model_function, *,
             return out
         eng = get_cached_engine(holder, model_function,
                                 device_batch_size=batch_size)
+        # pipelined __call__: pad of chunk k+1 overlaps compute of k and
+        # gather of k-1, streaming into one preallocated [n_valid, ...]
         res = np.asarray(eng(batch))
         flat = res.reshape(res.shape[0], -1).astype(np.float32)
         for row_list, i in zip(flat.tolist(), valid_idx):

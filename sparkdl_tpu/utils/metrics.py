@@ -86,6 +86,14 @@ class Metrics:
             return None
         return self._percentile(series, q)
 
+    def subset(self, prefix: str) -> Dict[str, float]:
+        """``summary()`` filtered to keys starting with ``prefix`` — the
+        shape consumers embed elsewhere (``bench.py`` per-config JSON
+        lines carry ``pipeline.*`` stage stalls; ``Server.stats`` carries
+        ``serving.*``)."""
+        return {k: v for k, v in self.summary().items()
+                if k.startswith(prefix)}
+
     def summary(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self.counters)

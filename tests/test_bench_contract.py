@@ -139,6 +139,150 @@ def test_serving_config_runs_on_cpu_fallback_when_relay_dead(captured,
         assert key in rec
 
 
+def test_midsession_relay_recovery_salvages_later_configs(captured,
+                                                          monkeypatch,
+                                                          tmp_path):
+    """A dead start-of-run probe must not blank the whole run: the relay
+    is RE-PROBED before each device config, so a mid-session recovery
+    runs everything that remains (and refreshes the last-good cache)."""
+    calls = {"n": 0}
+
+    def probe(timeout_s=240):
+        calls["n"] += 1
+        if calls["n"] <= 2:  # start-of-run probe + its long retry
+            raise subprocess.TimeoutExpired(cmd="p", timeout=timeout_s)
+        return {"dispatch_ms": 100.0, "h2d_MBps": 50.0, "d2h_MBps": 5.0}
+
+    monkeypatch.setattr(bench, "measure_relay_profile", probe)
+    monkeypatch.setattr(bench, "RELAY", {})
+    monkeypatch.setattr(bench, "RELAY_CACHE_PATH",
+                        str(tmp_path / "lg.json"))
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "1", lambda: ran.append("1"))
+    monkeypatch.setitem(bench.BENCHES, "3", lambda: ran.append("3"))
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1,3")
+    bench.main()
+    assert ran == ["1", "3"]  # both salvaged by the pre-config re-probe
+    relay_lines = [r for r in captured if r["config"] == "relay"]
+    assert any(r.get("recovered") for r in relay_lines)
+    assert not any("skipped" in (r.get("error") or "") for r in captured)
+    cached = json.loads((tmp_path / "lg.json").read_text())
+    assert cached["dispatch_ms"] == 100.0 and cached["ts"]
+
+
+def test_dead_relay_error_records_carry_last_good_profile(captured,
+                                                          monkeypatch,
+                                                          tmp_path):
+    """When every probe fails, the relay line AND each skip line carry
+    the last SUCCESSFUL probe's numbers with their staleness timestamp —
+    a dead-relay BENCH_r*.json stays interpretable on its own."""
+    cache = tmp_path / "lg.json"
+    cache.write_text(json.dumps({
+        "dispatch_ms": 108.5, "h2d_MBps": 34.0, "d2h_MBps": 4.1,
+        "ts": "2026-07-30T00:00:00+0000"}))
+    monkeypatch.setattr(bench, "RELAY_CACHE_PATH", str(cache))
+
+    def dead(timeout_s=240):
+        raise subprocess.TimeoutExpired(cmd="p", timeout=timeout_s)
+
+    monkeypatch.setattr(bench, "measure_relay_profile", dead)
+    monkeypatch.setattr(bench, "RELAY", {})
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1,3")
+    bench.main()
+    by_config = {}
+    for r in captured:
+        by_config.setdefault(r["config"], r)
+    for cfg in ("relay", "1", "3"):
+        lg = by_config[cfg]["last_good_relay"]
+        assert lg["dispatch_ms"] == 108.5
+        assert lg["ts"] == "2026-07-30T00:00:00+0000"  # staleness visible
+
+
+def test_successful_probe_writes_last_good_cache(captured, monkeypatch,
+                                                 tmp_path):
+    cache = tmp_path / "lg.json"
+    monkeypatch.setattr(bench, "RELAY_CACHE_PATH", str(cache))
+    monkeypatch.setattr(
+        bench, "measure_relay_profile",
+        lambda timeout_s=240: {"dispatch_ms": 1.0, "h2d_MBps": 2.0,
+                               "d2h_MBps": 3.0})
+    monkeypatch.setattr(bench, "RELAY", {})
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "none-such")
+    bench.main()
+    rec = json.loads(cache.read_text())
+    assert rec["dispatch_ms"] == 1.0 and rec["ts"]
+
+
+def test_dead_relay_runs_chipless_first_and_bounds_reprobes(captured,
+                                                            monkeypatch):
+    """Fully dead relay, full default config list: the chip-independent
+    configs run FIRST (guaranteed lines before any re-probe wait) and
+    the mid-run re-probe budget caps the added wait — after MAX_REPROBES
+    consecutive failures the remaining device configs skip instantly."""
+    probes = {"n": 0}
+
+    def dead(timeout_s=240):
+        probes["n"] += 1
+        raise subprocess.TimeoutExpired(cmd="p", timeout=timeout_s)
+
+    monkeypatch.setattr(bench, "measure_relay_profile", dead)
+    monkeypatch.setattr(bench, "RELAY", {})
+    order = []
+    monkeypatch.setitem(bench.BENCHES, "serving",
+                        lambda: order.append("serving"))
+    monkeypatch.setitem(bench.BENCHES, "pipeline",
+                        lambda: order.append("pipeline"))
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS",
+                       "1,1e2e,2,3,4,5,serving,pipeline")
+    bench.main()
+    assert order == ["serving", "pipeline"]  # chipless salvaged up front
+    skips = [r for r in captured if "skipped" in (r.get("error") or "")]
+    assert len(skips) == 6                   # every device config skipped
+    assert probes["n"] == 2 + bench.MAX_REPROBES  # start pair + budget
+    assert sum("budget" in r["error"] for r in skips) == 6 - bench.MAX_REPROBES
+
+
+def test_pipeline_config_is_chipless_and_runs_when_relay_dead(captured,
+                                                              monkeypatch):
+    """Like 'serving', the synthetic-device 'pipeline' config measures a
+    chip-independent layer and must run (not skip) on a dead relay."""
+    def dead(timeout_s=240):
+        raise subprocess.TimeoutExpired(cmd="p", timeout=timeout_s)
+
+    monkeypatch.setattr(bench, "measure_relay_profile", dead)
+    monkeypatch.setattr(bench, "RELAY", {})
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "pipeline",
+                        lambda: ran.append("pipeline"))
+    monkeypatch.setenv("SPARKDL_BENCH_CONFIGS", "1,pipeline")
+    bench.main()
+    assert ran == ["pipeline"]
+    by_config = {}
+    for r in captured:
+        by_config.setdefault(r["config"], r)
+    assert "skipped" in by_config["1"]["error"]
+    assert "pipeline" not in by_config or "error" not in by_config.get(
+        "pipeline", {})
+
+
+@pytest.mark.slow
+def test_pipeline_bench_line_contract(captured):
+    """The real synthetic-device child emits a line with the overlap
+    speedup and the per-stage stall ledger under the core contract keys
+    (slow: spawns a python child that imports jax + runs ~2.5s of
+    sleep-clocked batches)."""
+    bench.bench_pipeline()
+    rec = captured[-1]
+    assert rec["config"] == "pipeline"
+    assert rec["unit"] == "x vs serial path"
+    assert rec["value"] >= 1.5
+    assert rec["pipelined_s"] < rec["serial_s"]
+    assert rec["pipeline_stages"]["pipeline.dispatches"] == rec["n_batches"]
+    for key in ("config", "metric", "value", "unit", "vs_baseline",
+                "baseline", "env_bound"):
+        assert key in rec
+
+
 def test_relay_tag_formats_measured_profile(monkeypatch):
     monkeypatch.setattr(bench, "RELAY", {})
     assert "unmeasured" in bench._relay_tag()

@@ -312,6 +312,136 @@ def test_map_blocks_columnar():
         df.map_blocks(lambda rb: rb.to_pylist())
 
 
+def test_map_blocks_schema_promotion_matches_map_rows():
+    """map_blocks shares map_rows' promotion contract: an int-inferred
+    first batch must not raise against (or silently truncate) a later
+    float batch, and a column only some batches emit null-fills."""
+    import pyarrow as pa
+
+    df = DataFrame(pa.table({"a": [1, 2, 3, 4]}))
+
+    def block_widen(rb):
+        return pa.record_batch({"b": [v + 0.5 if v >= 3 else v
+                                      for v in rb.column(0).to_pylist()]})
+
+    out = df.map_blocks(block_widen, batch_size=2)
+    assert out.table.column("b").type == pa.float64()
+    assert [r["b"] for r in out.collect()] == [1.0, 2.0, 3.5, 4.5]
+
+    def block_missing(rb):
+        vals = rb.column(0).to_pylist()
+        cols = {"b": vals}
+        if max(vals) >= 3:
+            cols["c"] = ["x"] * len(vals)
+        return pa.record_batch(cols)
+
+    out2 = df.map_blocks(block_missing, batch_size=2)
+    assert [r["c"] for r in out2.collect()] == [None, None, "x", "x"]
+
+
+def test_map_blocks_fuzz_against_map_rows_oracle():
+    """Seeded fuzz: map_blocks must reproduce map_rows bit-exactly when
+    the block fn is the vectorized twin of the row fn — same random data,
+    null positions, chunkings, and the promotion edge cases (int->float
+    widening, null->concrete, per-batch missing columns) the map_rows
+    fuzz pinned (map_rows itself is fuzz-pinned against the old
+    to_pylist path)."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(4321)
+
+    def pairs():
+        # (row_fn, block_fn) twins — block fns go through the same
+        # Python value path so equality is bit-exact, not approximate
+        def row_widen(r):
+            return {"b": r["a"] + 0.5 if r["a"] >= 50 else r["a"],
+                    "s": r["s"]}
+
+        def blk_widen(rb):
+            a = rb.column(rb.schema.names.index("a")).to_pylist()
+            s = rb.column(rb.schema.names.index("s")).to_pylist()
+            return pa.record_batch(
+                {"b": [v + 0.5 if v >= 50 else v for v in a], "s": s})
+
+        def row_null(r):
+            return {"b": None if r["a"] % 3 == 0 else r["f"] * 2.0}
+
+        def blk_null(rb):
+            a = rb.column(rb.schema.names.index("a")).to_pylist()
+            f = rb.column(rb.schema.names.index("f")).to_pylist()
+            return pa.record_batch(
+                {"b": [None if x % 3 == 0 else y * 2.0
+                       for x, y in zip(a, f)]})
+
+        def row_rename(r):
+            return {"a2": r["a"] * 2, "f": r["f"]}
+
+        def blk_rename(rb):
+            a = rb.column(rb.schema.names.index("a")).to_pylist()
+            f = rb.column(rb.schema.names.index("f")).to_pylist()
+            return pa.record_batch({"a2": [v * 2 for v in a], "f": f})
+
+        return [(row_widen, blk_widen), (row_null, blk_null),
+                (row_rename, blk_rename)]
+
+    for trial in range(9):
+        n = int(rng.integers(3, 14))
+        tbl = pa.table({
+            "a": [int(v) for v in rng.integers(0, 100, n)],
+            "s": [f"s{v}" for v in rng.integers(0, 9, n)],
+            "f": [float(v) for v in rng.random(n)],
+        })
+        row_fn, blk_fn = pairs()[trial % 3]
+        bs = int(rng.integers(2, n + 2))
+        df = DataFrame(tbl).repartition(int(rng.integers(1, 4)))
+        got = df.map_blocks(blk_fn, batch_size=bs).table
+        want = df.map_rows(row_fn, batch_size=bs).table
+        assert got.schema == want.schema, (trial, bs)
+        assert got.to_pylist() == want.to_pylist(), (trial, bs)
+
+
+def test_with_column_rank3_nested_fixed_size_lists():
+    """rank>=3 numpy nests fixed_size_list per trailing dim, leaf dtype
+    preserved (pa.array alone refuses >1-D elements)."""
+    import pyarrow as pa
+
+    df = DataFrame({"k": [1, 2, 3]})
+    v = np.arange(3 * 2 * 4, dtype=np.float32).reshape(3, 2, 4)
+    out = df.withColumn("t", v)
+    t = out.table.column("t").type
+    assert pa.types.is_fixed_size_list(t) and t.list_size == 2
+    assert (pa.types.is_fixed_size_list(t.value_type)
+            and t.value_type.list_size == 4)
+    assert t.value_type.value_type == pa.float32()
+    assert out.table.column("t").to_pylist() == v.tolist()
+
+
+def test_with_column_rank_gt1_fuzz_against_row_oracle():
+    """Seeded fuzz over rank-2..4 numpy columns and int/float dtypes:
+    withColumn's buffer/nested path must reproduce the per-row Python
+    oracle (``values.tolist()``) bit-exactly — float32 -> Python float
+    widening is exact, so == is the right comparison — and rank-2
+    columns round-trip through column_to_numpy with dtype intact."""
+    rng = np.random.default_rng(99)
+    dtypes = [np.float32, np.float64, np.int32, np.int64]
+    for trial in range(10):
+        ndim = int(rng.integers(2, 5))
+        shape = tuple(int(v) for v in rng.integers(1, 5, ndim))
+        dt = dtypes[trial % len(dtypes)]
+        if np.issubdtype(dt, np.floating):
+            vals = rng.normal(size=shape).astype(dt)
+        else:
+            vals = rng.integers(-1000, 1000, size=shape).astype(dt)
+        df = DataFrame({"k": list(range(shape[0]))})
+        out = df.withColumn("v", vals)
+        got = out.table.column("v").to_pylist()
+        assert got == vals.tolist(), (trial, shape, dt)
+        if ndim == 2:
+            back = out.column_to_numpy("v")
+            np.testing.assert_array_equal(back, vals)
+            assert back.dtype == dt
+
+
 def test_column_to_numpy_buffer_path_parity(rng):
     """Uniform list<float> columns read straight from the values buffer:
     identical result to the old to_pylist row path, across chunked,

@@ -118,12 +118,14 @@ def test_engine_batches_per_dispatch_tail_uses_plain_program(setup,
     eng = InferenceEngine(_fn, variables, device_batch_size=16,
                           batches_per_dispatch=3)
     calls = {"group": 0, "plain": 0}
-    orig_group, orig_plain = eng._run_group, eng.run_padded
-    monkeypatch.setattr(eng, "_run_group", lambda p: (
-        calls.__setitem__("group", calls["group"] + 1), orig_group(p))[1])
+    orig_group, orig_plain = eng._dispatch_group, eng.run_padded
+    monkeypatch.setattr(eng, "_dispatch_group", lambda s: (
+        calls.__setitem__("group", calls["group"] + 1), orig_group(s))[1])
     monkeypatch.setattr(eng, "run_padded", lambda b: (
         calls.__setitem__("plain", calls["plain"] + 1), orig_plain(b))[1])
-    out = eng(np.concatenate([x, x[:19]]))  # 64 rows = 4 pieces: 3 + 1
+    # serial path pinned: the call-count choreography under test is the
+    # single-threaded one (test_pipeline covers the threaded analog)
+    out = eng(np.concatenate([x, x[:19]]), pipeline=False)  # 4 pieces: 3+1
     assert out.shape[0] == 64
     assert calls == {"group": 1, "plain": 1}
 
@@ -140,12 +142,15 @@ def test_engine_grouped_dispatch_scales_inflight_window(setup, monkeypatch):
     eng = InferenceEngine(_fn, variables, device_batch_size=16,
                           batches_per_dispatch=3)
     events = []
-    orig_group, orig_trim = eng._run_group, eng._trim
-    monkeypatch.setattr(eng, "_run_group", lambda p: (
-        events.append("dispatch"), orig_group(p))[1])
+    orig_group, orig_trim = eng._dispatch_group, eng._trim
+    monkeypatch.setattr(eng, "_dispatch_group", lambda s: (
+        events.append("dispatch"), orig_group(s))[1])
     monkeypatch.setattr(eng, "_trim", lambda o, n: (
         events.append("trim"), orig_trim(o, n))[1])
-    outs = list(eng.map_batches([x], window=2))
+    # serial path pinned: dispatch/trim interleaving on ONE thread is the
+    # invariant under test (the pipelined runner bounds residency with
+    # queue capacities instead — test_pipeline)
+    outs = list(eng.map_batches([x], window=2, pipeline=False))
     np.testing.assert_allclose(np.concatenate(outs), ref, rtol=1e-5,
                                atol=1e-6)
     # every 3rd trim completes one group's gather
@@ -271,7 +276,9 @@ def test_engine_call_bounds_inflight_window(setup, monkeypatch):
     monkeypatch.setattr(eng, "_trim",
                         lambda out, n: (events.append("gather"),
                                         orig_trim(out, n))[1])
-    out = eng(x, window=2)  # 45 rows / 8 = 6 chunks
+    # serial path pinned: single-thread event ordering is the invariant
+    # under test (pipelined residency bounds live in test_pipeline)
+    out = eng(x, window=2, pipeline=False)  # 45 rows / 8 = 6 chunks
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
     # With 6 chunks and window=2, the first gather must happen before the
     # last dispatch (not all dispatches first, as in round 1).

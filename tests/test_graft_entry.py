@@ -25,12 +25,14 @@ def test_dryrun_subprocess_fallback_when_devices_insufficient():
     __graft_entry__.dryrun_multichip(16)
 
 
-def test_dryrun_pins_cpu_platform_before_device_probe(monkeypatch):
-    """The MULTICHIP hang mode: probing ``len(jax.devices())`` with no
-    platform pinned initializes the default backend, which blocks forever
-    on a dead TPU relay.  The probe must be preceded by the same
-    ``jax.config.update('jax_platforms', 'cpu')`` pin the subprocess and
-    conftest use."""
+def test_dryrun_gates_on_subprocess_probe_and_pins_before_parent_probe(
+        monkeypatch):
+    """The MULTICHIP r05 hang mode: ``len(jax.devices())`` on an UNPINNED
+    parent initializes whatever backend the environment chose, which
+    blocks forever inside native code on a dead TPU relay.  The decision
+    must be gated by the short-timeout subprocess probe first, and any
+    parent-side device count (the committed-backend re-check) must come
+    strictly AFTER the CPU pin."""
     import jax
 
     calls = []
@@ -42,13 +44,64 @@ def test_dryrun_pins_cpu_platform_before_device_probe(monkeypatch):
         jax, "devices",
         lambda *a, **kw: (calls.append(("devices",)),
                           orig_devices(*a, **kw))[1])
+    probed = []
+    orig_probe = __graft_entry__._probe_local_device_count
+    monkeypatch.setattr(
+        __graft_entry__, "_probe_local_device_count",
+        lambda *a, **kw: (probed.append(1), orig_probe(*a, **kw))[1])
     # the probe decision is what's under test, not the step itself
     monkeypatch.setattr(__graft_entry__, "_dryrun_impl", lambda n: None)
-    __graft_entry__.dryrun_multichip(8)
+    __graft_entry__.dryrun_multichip(8)  # conftest env: probe child sees 8
+    assert probed == [1]                 # subprocess probe gated the path
     pin = ("update", "jax_platforms", "cpu")
-    assert pin in calls
-    assert ("devices",) in calls
+    assert pin in calls and ("devices",) in calls
     assert calls.index(pin) < calls.index(("devices",))
+
+
+def test_dryrun_survives_hanging_backend_probe(monkeypatch):
+    """Simulate the dead-relay hang: the probe child blocks forever (as a
+    backend init on a dead relay does).  dryrun_multichip must kill it at
+    the probe timeout and complete via the virtual-subprocess path —
+    never touching the parent's jax backend — instead of hanging until
+    the driver's rc=124 kill."""
+    import time
+
+    import jax
+
+    monkeypatch.setattr(__graft_entry__, "_DEVICE_COUNT_PROBE",
+                        "import time\ntime.sleep(600)\n")
+    monkeypatch.setattr(__graft_entry__, "_PROBE_TIMEOUT_S", 2)
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+            "parent touched jax.devices() on the dead-relay path")))
+    ran = []
+    monkeypatch.setattr(__graft_entry__, "_dryrun_in_virtual_subprocess",
+                        lambda n: ran.append(n))
+    t0 = time.monotonic()
+    __graft_entry__.dryrun_multichip(8)
+    assert ran == [8]                      # fell back, completed ok
+    assert time.monotonic() - t0 < 30      # bounded by the probe timeout
+
+
+def test_dryrun_falls_back_when_parent_backend_disagrees_with_probe(
+        monkeypatch):
+    """A caller whose jax backend is ALREADY committed (CPU pin no-ops)
+    may expose fewer devices than the probe child saw — the re-check must
+    route to the virtual subprocess instead of failing mesh creation."""
+    import jax
+
+    monkeypatch.setattr(__graft_entry__, "_probe_local_device_count",
+                        lambda *a, **kw: 8)
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **kw: [object()])  # parent sees 1
+    ran = {"sub": [], "impl": []}
+    monkeypatch.setattr(__graft_entry__, "_dryrun_in_virtual_subprocess",
+                        lambda n: ran["sub"].append(n))
+    monkeypatch.setattr(__graft_entry__, "_dryrun_impl",
+                        lambda n: ran["impl"].append(n))
+    __graft_entry__.dryrun_multichip(8)
+    assert ran == {"sub": [8], "impl": []}
 
 
 def test_entry_compiles_single_chip():
