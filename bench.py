@@ -40,7 +40,9 @@ still printed it; it is re-emitted last on completion),
 SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
 (bfloat16|float32), SPARKDL_BENCH_SERVING_REQUESTS (512),
 SPARKDL_BENCH_REPROBE_TIMEOUT (120), SPARKDL_RELAY_CACHE (last-good
-relay profile path).
+relay profile path), SPARKDL_BENCH_TRACE (default 1: per-config span
+tracing; each line carries ``metrics_snapshot`` + ``trace_artifact``),
+SPARKDL_BENCH_TRACE_DIR (artifact dir, default artifacts/bench_traces).
 
 Dead-relay behavior: a failed start-of-run probe no longer blanks the
 whole run — the chip-independent configs run FIRST (their lines are
@@ -66,6 +68,8 @@ import os
 import time
 
 import numpy as np
+
+from sparkdl_tpu.utils.metrics import Metrics
 
 V100_BASELINE_IPS = 875.0
 
@@ -106,6 +110,85 @@ BATCH = int(os.environ.get("SPARKDL_BENCH_BATCH", "128"))
 STEPS = int(os.environ.get("SPARKDL_BENCH_STEPS", "20"))
 DTYPE = os.environ.get("SPARKDL_BENCH_DTYPE", "bfloat16")
 
+# Per-config observability (sparkdl_tpu.obs): main() gives every config
+# a FRESH Metrics registry — counters/timings from earlier configs in
+# the same run must never leak into a later config's JSON line — plus a
+# per-config span-trace artifact (Chrome trace JSON under TRACE_DIR;
+# subprocess configs inherit SPARKDL_TRACE=<subdir> and flush their
+# own).  emit() then attaches BOTH to the line: ``metrics_snapshot``
+# (stable schema, obs.export.metrics_snapshot) and ``trace_artifact``
+# (the path), so driver records carry per-stage breakdowns, not just
+# headline throughput.  SPARKDL_BENCH_TRACE=0 disables the tracing half
+# (the fresh per-config registry always applies).
+BENCH_TRACE = os.environ.get("SPARKDL_BENCH_TRACE", "1").strip().lower() \
+    not in ("0", "false", "off", "no")
+TRACE_DIR = os.environ.get(
+    "SPARKDL_BENCH_TRACE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "artifacts", "bench_traces"))
+
+_CONFIG_OBS = {"metrics": None, "trace_artifact": None}
+
+
+def _config_metrics() -> Metrics:
+    """The per-config registry main() provisioned, or a private one when
+    a bench fn runs outside main() (unit tests, direct calls)."""
+    m = _CONFIG_OBS.get("metrics")
+    return m if m is not None else Metrics()
+
+
+def _begin_config_obs(key: str) -> None:
+    _CONFIG_OBS["metrics"] = Metrics()
+    _CONFIG_OBS["trace_artifact"] = None
+    if not BENCH_TRACE:
+        return
+    from sparkdl_tpu import obs
+
+    if key in _CHIPLESS_CONFIGS:
+        # subprocess configs trace themselves: the child sees
+        # SPARKDL_TRACE=<subdir> and atexit-flushes trace_<pid>.json.
+        # Pre-create the dir so the advertised path exists even if the
+        # child records nothing.
+        path = os.path.join(TRACE_DIR, key)
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError:
+            path = None  # read-only checkout: don't advertise a path
+        _CONFIG_OBS["trace_artifact"] = path
+    else:
+        path = os.path.join(TRACE_DIR, f"trace_{key}.json")
+        try:
+            os.makedirs(TRACE_DIR, exist_ok=True)
+        except OSError:
+            path = None  # read-only checkout: don't advertise a path
+        _CONFIG_OBS["trace_artifact"] = path
+    obs.configure(enabled=True)  # fresh tracer => empty ring per config
+
+
+def _end_config_obs(key: str) -> None:
+    m = _CONFIG_OBS.get("metrics")
+    _CONFIG_OBS["metrics"] = None
+    path = _CONFIG_OBS.get("trace_artifact")
+    _CONFIG_OBS["trace_artifact"] = None
+    if not BENCH_TRACE:
+        return
+    try:
+        from sparkdl_tpu import obs
+
+        if path and path.endswith(".json"):
+            # ALWAYS write the advertised artifact — an empty
+            # traceEvents list is still a valid, openable Chrome trace,
+            # so a driver following the line's path never 404s
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            obs.write_chrome_trace(path, obs.get_tracer().snapshot())
+        if m is not None and any(m.snapshot_raw().values()):
+            os.makedirs(TRACE_DIR, exist_ok=True)
+            obs.write_metrics_jsonl(
+                os.path.join(TRACE_DIR, "metrics.jsonl"), m,
+                extra={"config": key})
+    except OSError:
+        pass  # a read-only checkout must not fail the bench
+
 
 _LINES = {}
 _LAST_PRINTED = [None]
@@ -145,6 +228,22 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
             raise ValueError(f"emit extra field {k!r} collides with a "
                              f"core contract key")
         rec[k] = v
+    # per-config observability riders (main() provisions them; absent
+    # when a bench fn runs standalone): the config's own Metrics
+    # snapshot and the span-trace artifact path.  ``extra`` wins — a
+    # config that measured its metrics in a subprocess (serving) passes
+    # the child's snapshot through extra and the parent's empty
+    # registry must not shadow it.
+    m = _CONFIG_OBS.get("metrics")
+    if m is not None and "metrics_snapshot" not in rec:
+        from sparkdl_tpu.obs.export import metrics_snapshot
+
+        snap = metrics_snapshot(m)
+        if any(snap.values()):
+            rec["metrics_snapshot"] = snap
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta is not None and "trace_artifact" not in rec:
+        rec["trace_artifact"] = ta
     line = json.dumps(rec)
     _LINES[config] = line
     _print_line(line)
@@ -309,7 +408,8 @@ def _zoo_fn(name, featurize):
     return fn, variables, spec.input_size
 
 
-def measure_scan(fn, variables, h, w, batch, steps, distinct=4):
+def measure_scan(fn, variables, h, w, batch, steps, distinct=4,
+                 metrics=None):
     """images/sec/chip via steps-in-one-program (relay-artifact-free).
 
     The scan iterates ``steps`` times over a small ROTATING corpus of
@@ -348,6 +448,9 @@ def measure_scan(fn, variables, h, w, batch, steps, distinct=4):
     t0 = time.perf_counter()
     float(g(eng.variables, xd))  # one dispatch, one scalar fetch
     elapsed = time.perf_counter() - t0
+    if metrics is not None:  # the numbers behind the headline, exported
+        metrics.record_time("bench.scan", elapsed)
+        metrics.incr("bench.images", steps * eng.device_batch_size)
     return steps * eng.device_batch_size / elapsed / eng.num_devices
 
 
@@ -371,7 +474,8 @@ def bench_config1_device():
     # 2x steps: one dispatch + one D2H fetch cost ~100 ms through the
     # relay regardless of K — more steps = closer to steady state.
     fn, variables, (h, w) = _zoo_fn("InceptionV3", featurize=True)
-    ips = measure_scan(fn, variables, h, w, BATCH, STEPS * 2)
+    ips = measure_scan(fn, variables, h, w, BATCH, STEPS * 2,
+                       metrics=_config_metrics())
     emit("1", "InceptionV3 ImageNet featurization throughput", ips,
          "images/sec/chip", baseline_model="InceptionV3")
 
@@ -387,7 +491,8 @@ def bench_config1_e2e():
     fn, variables, (h, w) = _zoo_fn("InceptionV3", featurize=True)
     eng = InferenceEngine(fn, variables, device_batch_size=BATCH,
                           compute_dtype=_compute_dtype(),
-                          output_host_dtype=np.float32)
+                          output_host_dtype=np.float32,
+                          metrics=_config_metrics())
     n = int(os.environ.get("SPARKDL_BENCH_E2E_IMAGES", "384"))
     blobs = _jpeg_corpus(n)
 
@@ -425,7 +530,8 @@ def bench_config2():
     for name in ("ResNet50", "Xception", "VGG16", "VGG19", "MobileNetV2"):
         fn, variables, (h, w) = _zoo_fn(name, featurize=False)
         steps = STEPS * 2  # amortize the fixed relay fetch cost
-        ips = measure_scan(fn, variables, h, w, BATCH, steps)
+        ips = measure_scan(fn, variables, h, w, BATCH, steps,
+                           metrics=_config_metrics())
         emit(f"2-{name}", f"DeepImagePredictor {name} batch inference", ips,
              "images/sec/chip", baseline_model=name)
 
@@ -457,6 +563,9 @@ def bench_config3():
     out = t.transform(df)
     elapsed = time.perf_counter() - t0
     assert len(out) == n
+    m = _config_metrics()
+    m.record_time("bench.transform", elapsed)
+    m.incr("bench.rows", n)
     emit("3", "KerasTransformer user-MLP rows/sec", n / elapsed, "rows/sec",
          env_bound=_relay_tag() + " (PERF.md)")
 
@@ -500,6 +609,9 @@ def bench_config4():
     out = udf_registry.apply("bench_inception_udf", df, "image", "probs")
     elapsed = time.perf_counter() - t0
     assert len(out) == n
+    m = _config_metrics()
+    m.record_time("bench.udf_apply", elapsed)
+    m.incr("bench.images", n)
     emit("4", "registerKerasImageUDF-style image UDF scoring", n / elapsed,
          "images/sec", baseline_model="InceptionV3",
          env_bound=_relay_tag() + "+1vcpu-host (PERF.md: probability "
@@ -559,6 +671,9 @@ def bench_config5():
     elapsed = time.perf_counter() - t0
     assert len(models) == len(maps)
     epochs_total = 2 * len(maps)
+    m = _config_metrics()
+    m.record_time("bench.fit", elapsed)
+    m.incr("bench.train_images", n * epochs_total)
     emit("5", "ImageFileEstimator param-grid tuning throughput",
          n * epochs_total / elapsed, "train-images/sec",
          env_bound=_relay_tag() + "-per-step+1vcpu-host (PERF.md)")
@@ -595,6 +710,7 @@ for f in futs:
 elapsed = time.perf_counter() - t0
 m = srv.metrics
 fill = m.histograms.get("serving.batch_fill_ratio", [])
+from sparkdl_tpu.obs.export import metrics_snapshot
 out = {
     "ips": n / elapsed,
     "p50_ms": 1e3 * m.percentile("serving.request_latency", 50),
@@ -602,6 +718,7 @@ out = {
     "batch_fill_ratio": (sum(fill) / len(fill)) if fill else None,
     "num_requests": n,
     "num_batches": int(m.counters.get("serving.batches", 0)),
+    "metrics_snapshot": metrics_snapshot(m),
 }
 srv.close()
 print(json.dumps(out))
@@ -620,6 +737,9 @@ def bench_serving():
     env = dict(os.environ)
     if cpu_fallback:
         env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
     prof = _run_json_subprocess(_SERVING_BENCH, timeout_s=480, env=env)
     if cpu_fallback:
         bound = ("cpu-fallback: relay unreachable at bench start; serving "
@@ -640,6 +760,10 @@ def bench_serving():
                                   if prof.get("batch_fill_ratio") is not None
                                   else None),
              "num_requests": prof["num_requests"],
+             # the CHILD's registry: the serving stack ran over there,
+             # the parent's per-config registry saw nothing
+             **({"metrics_snapshot": prof["metrics_snapshot"]}
+                if prof.get("metrics_snapshot") else {}),
          })
 
 
@@ -652,8 +776,13 @@ _PIPELINE_BENCH = r"""
 import json
 import jax
 jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.obs.export import metrics_snapshot
 from sparkdl_tpu.parallel.pipeline import synthetic_overlap_benchmark
-print(json.dumps(synthetic_overlap_benchmark()))
+from sparkdl_tpu.utils.metrics import Metrics
+m = Metrics()
+out = synthetic_overlap_benchmark(metrics=m)
+out["metrics_snapshot"] = metrics_snapshot(m)
+print(json.dumps(out))
 """
 
 
@@ -664,6 +793,9 @@ def bench_pipeline():
     (tests/test_pipeline.py) asserts >= 1.5x on this same benchmark."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
     prof = _run_json_subprocess(_PIPELINE_BENCH, timeout_s=480, env=env)
     emit("pipeline",
          "pipelined host/device overlap speedup (synthetic slow device)",
@@ -677,6 +809,9 @@ def bench_pipeline():
              "prepare_ms": prof["prepare_ms"],
              "n_batches": prof["n_batches"],
              "pipeline_stages": prof["stages"],
+             # the CHILD's registry (see bench_serving)
+             **({"metrics_snapshot": prof["metrics_snapshot"]}
+                if prof.get("metrics_snapshot") else {}),
          })
 
 
@@ -785,9 +920,18 @@ def main():
                     "(re-probed before this config; see 'relay' line)")))
                 continue
         try:
+            _begin_config_obs(key)
             fn()
         except Exception as e:  # one failing config must not kill the rest
             _print_line(json.dumps({"config": key, "error": repr(e)[:300]}))
+        finally:
+            _end_config_obs(key)
+    # bench-owned tracer state must not leak into the embedding process
+    # (contract tests import bench and call main() in-process)
+    if BENCH_TRACE:
+        from sparkdl_tpu import obs
+
+        obs.configure_from_env()
     # re-emit the relay profile near the tail so it survives tail-window
     # capture, then end on the headline metric whenever it was measured
     # (even if later configs errored) for a parse-the-final-line driver

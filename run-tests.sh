@@ -31,4 +31,47 @@ if [[ ! -f tests/test_pipeline.py ]]; then
        "layer's overlap + parity contract would ship unasserted" >&2
   exit 1
 fi
-exec python -m pytest tests/ -q --durations=10 "$@"
+if [[ ! -f tests/test_obs.py ]]; then
+  echo "FATAL: tests/test_obs.py missing — the observability layer" \
+       "(span tracing, exporters, exemplars) would ship untested" >&2
+  exit 1
+fi
+python -m pytest tests/ -q --durations=10 "$@"
+
+# Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device
+# benchmark must show that (a) DISABLED tracing (SPARKDL_TRACE=0) adds
+# ~nothing — the pipelined wall stays within a small factor of the
+# sleep-math ideal (n_batches x max(prepare, dispatch) = the untraced
+# baseline this benchmark has asserted since PR 2) — and (b) with
+# tracing ON the >= 1.5x overlap contract still holds.  Sleep-dominated
+# on the CPU backend, so the factors are deterministic on any host.
+echo "== tracing-overhead guard =="
+python - <<'PY'
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu import obs
+from sparkdl_tpu.parallel.pipeline import synthetic_overlap_benchmark
+
+obs.configure(enabled=False)          # SPARKDL_TRACE=0 equivalent
+off = synthetic_overlap_benchmark()
+obs.configure(enabled=True)           # SPARKDL_TRACE=1 equivalent
+on = synthetic_overlap_benchmark()
+obs.configure_from_env()
+ideal = off["n_batches"] * max(off["prepare_ms"], off["dispatch_ms"]) / 1e3
+print(json.dumps({"ideal_s": ideal,
+                  "untraced_pipelined_s": off["pipelined_s"],
+                  "traced_pipelined_s": on["pipelined_s"],
+                  "untraced_speedup": off["speedup"],
+                  "traced_speedup": on["speedup"]}))
+assert off["pipelined_s"] <= 1.35 * ideal, (
+    f"disabled-tracing pipelined wall {off['pipelined_s']:.3f}s exceeds "
+    f"1.35x the {ideal:.1f}s untraced ideal — the SPARKDL_TRACE=0 path "
+    f"is no longer near-zero cost")
+assert off["speedup"] >= 1.5, off
+assert on["speedup"] >= 1.5, (
+    f"overlap contract broken WITH tracing on: {on['speedup']:.2f}x < 1.5x")
+print("tracing-overhead guard ok")
+PY

@@ -1,0 +1,49 @@
+"""sparkdl_tpu.obs — span tracing, metrics export, and slow-request
+exemplars for the scoring and serving stack.
+
+The observability layer SURVEY.md §5 found missing from the reference
+(Spark UI only): every request/batch carries a trace, every stage emits
+spans, and every run can export a machine-readable record.
+
+* :mod:`~sparkdl_tpu.obs.trace` — :class:`Tracer` / spans / the
+  ``SPARKDL_TRACE=0|1|dir`` gate (disabled path near-zero cost).
+* :mod:`~sparkdl_tpu.obs.export` — Chrome trace-event JSON (Perfetto /
+  chrome://tracing), Prometheus text exposition, and JSONL snapshots of
+  the :class:`~sparkdl_tpu.utils.metrics.Metrics` registry.
+* :mod:`~sparkdl_tpu.obs.exemplar` — top-K slowest request span trees,
+  surfaced by ``Server.varz()``.
+
+Instrumented surfaces: ``serving.Server``/``DynamicBatcher`` (request +
+micro-batch spans), ``parallel.engine.InferenceEngine`` (call/dispatch
+spans), ``parallel.pipeline.PipelinedRunner`` (per-stage spans with
+``block_until_ready``-bracketed device time), and ``bench.py`` (one
+trace artifact + metrics snapshot per config line).
+"""
+
+from sparkdl_tpu.obs.exemplar import ExemplarReservoir
+from sparkdl_tpu.obs.export import (load_spans, metrics_snapshot,
+                                    prometheus_text, to_chrome_trace,
+                                    write_chrome_trace,
+                                    write_metrics_jsonl, write_spans_jsonl)
+from sparkdl_tpu.obs.trace import (NULL_SPAN, Span, Tracer, configure,
+                                   configure_from_env, current_trace_id,
+                                   get_tracer, tracing_from_env)
+
+__all__ = [
+    "Tracer",
+    "Span",
+    "NULL_SPAN",
+    "get_tracer",
+    "configure",
+    "configure_from_env",
+    "current_trace_id",
+    "tracing_from_env",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "write_spans_jsonl",
+    "load_spans",
+    "metrics_snapshot",
+    "write_metrics_jsonl",
+    "prometheus_text",
+    "ExemplarReservoir",
+]

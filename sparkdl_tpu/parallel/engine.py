@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.parallel import mesh as mesh_lib
 from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
                                            pipeline_enabled_from_env)
@@ -231,8 +232,13 @@ class InferenceEngine:
             raise ValueError(
                 f"run_padded expects batch of {self.device_batch_size}, "
                 f"got {self._leaves(batch)}")
-        x = jax.device_put(batch, self._batch_sharding)
-        return self._compiled(self.variables, x)
+        # span covers H2D + async launch only (the call returns as soon
+        # as the dispatch is enqueued); the device wait is bracketed by
+        # whichever stage forces the result (pipeline.gather / _trim)
+        with get_tracer().span("engine.dispatch",
+                               rows=self.device_batch_size):
+            x = jax.device_put(batch, self._batch_sharding)
+            return self._compiled(self.variables, x)
 
     def _pad(self, chunk):
         import jax
@@ -311,30 +317,31 @@ class InferenceEngine:
         use_pipe = (pipeline_enabled_from_env() if pipeline is None
                     else bool(pipeline))
         t0 = time.perf_counter()
-        if not use_pipe or n <= self.device_batch_size:
-            outs = list(self.map_batches([batch], window=window,
-                                         pipeline=False))
-            result = jax.tree_util.tree_map(
-                lambda *parts: np.concatenate(parts, axis=0), *outs)
-        else:
-            out = None
-            off = 0
-            for part in self.map_batches([batch], window=window,
-                                         pipeline=True):
-                k = self._leaves(part)
-                if out is None:
-                    # leaf trailing shapes are fixed by the one compiled
-                    # program: preallocate [n, ...] per leaf and stream
-                    # chunks straight in
-                    out = jax.tree_util.tree_map(
-                        lambda a: np.empty((n,) + a.shape[1:], a.dtype),
-                        part)
-                    self.metrics.incr("engine_call_prealloc")
-                for dst, src in zip(jax.tree_util.tree_leaves(out),
-                                    jax.tree_util.tree_leaves(part)):
-                    dst[off:off + k] = src
-                off += k
-            result = out
+        with get_tracer().span("engine.call", rows=n):
+            if not use_pipe or n <= self.device_batch_size:
+                outs = list(self.map_batches([batch], window=window,
+                                             pipeline=False))
+                result = jax.tree_util.tree_map(
+                    lambda *parts: np.concatenate(parts, axis=0), *outs)
+            else:
+                out = None
+                off = 0
+                for part in self.map_batches([batch], window=window,
+                                             pipeline=True):
+                    k = self._leaves(part)
+                    if out is None:
+                        # leaf trailing shapes are fixed by the one
+                        # compiled program: preallocate [n, ...] per leaf
+                        # and stream chunks straight in
+                        out = jax.tree_util.tree_map(
+                            lambda a: np.empty((n,) + a.shape[1:], a.dtype),
+                            part)
+                        self.metrics.incr("engine_call_prealloc")
+                    for dst, src in zip(jax.tree_util.tree_leaves(out),
+                                        jax.tree_util.tree_leaves(part)):
+                        dst[off:off + k] = src
+                    off += k
+                result = out
         elapsed = time.perf_counter() - t0
         self.metrics.incr("items", n)
         self.metrics.record_time("engine_call", elapsed)
@@ -359,8 +366,10 @@ class InferenceEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sh = NamedSharding(self.mesh, P(None, mesh_lib.DATA_AXIS))
-        return self._compiled_group(self.variables,
-                                    jax.device_put(stacked, sh))
+        with get_tracer().span("engine.dispatch",
+                               group=self.batches_per_dispatch):
+            return self._compiled_group(self.variables,
+                                        jax.device_put(stacked, sh))
 
     # -- streaming API -----------------------------------------------------
     def map_batches(self, batches: Iterable[Any], window: int = 2,

@@ -42,6 +42,7 @@ from typing import Any, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 
@@ -127,6 +128,16 @@ class PipelinedRunner:
         stop = threading.Event()
         errors: list = []
 
+        # Observability: one "pipeline.run" span brackets the whole
+        # stage graph (parented to the consumer thread's current span,
+        # e.g. engine.call); each stage emits one child span per piece.
+        # Disabled tracing costs one enabled-check per piece — the
+        # stage code paths are otherwise byte-identical.
+        tracer = get_tracer()
+        run_span = (tracer.start_span("pipeline.run",
+                                      parent=tracer.current())
+                    if tracer.enabled else None)
+
         prep_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         disp_q: "queue.Queue" = queue.Queue(maxsize=self.window)
         out_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -139,11 +150,22 @@ class PipelinedRunner:
             # the engine's OWN piece iterator (the serial path consumes
             # the same one), so dispatch order is shared by construction
             try:
-                for item in eng._iter_pieces(batches):
+                src = eng._iter_pieces(batches)
+                idx = 0
+                while True:
+                    with tracer.span("pipeline.prepare", parent=run_span,
+                                     piece=idx) as sp:
+                        item = next(src, _DONE)
+                        if item is _DONE:
+                            sp.annotate(eos=True)
+                    if item is _DONE:
+                        self._put(prep_q, _DONE, stop, "prepare",
+                                  "prep_q")
+                        return
+                    idx += 1
                     if not self._put(prep_q, item, stop, "prepare",
                                      "prep_q"):
                         return
-                self._put(prep_q, _DONE, stop, "prepare", "prep_q")
             except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
                 fail(e)
 
@@ -158,8 +180,10 @@ class PipelinedRunner:
                     kind, ns, host = item
                     # H2D + async launch: returns as soon as the transfer
                     # is enqueued; the device computes while we loop
-                    dev = (eng.run_padded(host) if kind == "plain"
-                           else eng._dispatch_group(host))
+                    with tracer.span("pipeline.dispatch",
+                                     parent=run_span, kind=kind):
+                        dev = (eng.run_padded(host) if kind == "plain"
+                               else eng._dispatch_group(host))
                     m.incr("pipeline.dispatches")
                     if not self._put(disp_q, (kind, ns, dev), stop,
                                      "dispatch", "inflight_q"):
@@ -177,20 +201,28 @@ class PipelinedRunner:
                     if item is _DONE:
                         break
                     kind, ns, dev = item
-                    if kind == "plain":
-                        if not self._put(out_q, eng._trim(dev, ns), stop,
-                                         "gather", "out_q"):
+                    # span covers device wait + D2H + trim, NOT the
+                    # downstream puts (backpressure is a separate story
+                    # told by pipeline.gather_out_stall_s); when tracing
+                    # is on, block_until_ready splits device wait
+                    # (device_us) from the host-side copy/cast
+                    with tracer.span("pipeline.gather", parent=run_span,
+                                     kind=kind) as sp:
+                        sp.block_until_ready(dev)
+                        if kind == "plain":
+                            parts = [eng._trim(dev, ns)]
+                        else:
+                            # one D2H fetch for the whole group, sliced
+                            # on the host (same as the serial drain)
+                            host = jax.tree_util.tree_map(np.asarray, dev)
+                            parts = [
+                                eng._trim(jax.tree_util.tree_map(
+                                    lambda a, i=i: a[i], host), n)
+                                for i, n in enumerate(ns)]
+                    for part in parts:
+                        if not self._put(out_q, part, stop, "gather",
+                                         "out_q"):
                             return
-                    else:
-                        # one D2H fetch for the whole group, sliced on the
-                        # host (same as the serial drain)
-                        host = jax.tree_util.tree_map(np.asarray, dev)
-                        for i, n in enumerate(ns):
-                            part = eng._trim(jax.tree_util.tree_map(
-                                lambda a, i=i: a[i], host), n)
-                            if not self._put(out_q, part, stop, "gather",
-                                             "out_q"):
-                                return
                     m.incr("pipeline.gathers")
                 self._put(out_q, _DONE, stop, "gather", "out_q")
             except BaseException as e:  # noqa: BLE001
@@ -221,6 +253,13 @@ class PipelinedRunner:
             # cancels every stage whether we finished, raised, or the
             # consumer closed the iterator early
             stop.set()
+            if run_span is not None:
+                # bounded join so stage spans close BEFORE their parent
+                # (the child-within-parent invariant tests rely on);
+                # threads exit within one 50 ms queue-poll of stop
+                for t in threads:
+                    t.join(timeout=2.0)
+                run_span.finish()
         if errors:
             raise errors[0]
 

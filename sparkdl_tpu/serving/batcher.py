@@ -20,6 +20,7 @@ from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, List, Optional
 
+from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import (DeadlineExceededError, QueueFullError,
                                         ServerClosedError)
 from sparkdl_tpu.utils.logging import get_logger
@@ -34,20 +35,38 @@ class Request:
     ``deadline`` is absolute ``time.monotonic()`` seconds (None = no
     deadline).  The future settles exactly once — with the model output
     row, or with a serving error (shed / rejected / batch failure).
+
+    Tracing (``SPARKDL_TRACE``): ``span`` is the request's root span
+    (opened at submit, closed at settle); ``batch_span`` rides the
+    FIRST live request of a flushed micro-batch and carries the
+    batcher→engine segment (see :meth:`DynamicBatcher.next_batch`).
+    Both stay None with tracing off.
     """
 
-    __slots__ = ("payload", "future", "enqueued_at", "deadline")
+    __slots__ = ("payload", "future", "enqueued_at", "deadline", "span",
+                 "batch_span")
 
     def __init__(self, payload: Any, deadline: Optional[float] = None):
         self.payload = payload
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
         self.deadline = deadline
+        self.span = None
+        self.batch_span = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
             return False
         return (time.monotonic() if now is None else now) >= self.deadline
+
+    def finish_span(self, status: str = "ok") -> None:
+        """Close this request's root span exactly once (settle paths
+        race: worker demux vs. watchdog vs. close — ``Span.finish`` is
+        idempotent, so the losers are no-ops)."""
+        sp = self.span
+        if sp is not None:
+            self.span = None
+            sp.finish(status)
 
 
 class DynamicBatcher:
@@ -158,7 +177,19 @@ class DynamicBatcher:
         # expiry is judged at the flush DECISION: a request the guard
         # selected while still live dispatches even if the pop itself was
         # delayed past its deadline by scheduling jitter
-        return self._shed_expired(batch, now)
+        live = self._shed_expired(batch, now)
+        tracer = get_tracer()
+        if tracer.enabled and live:
+            # the micro-batch span adopts the FIRST live request's trace
+            # (the convention that keeps one strict serving → batcher →
+            # engine nesting chain; sibling requests keep their own root
+            # spans and are recorded on the batch as an attribute)
+            live[0].batch_span = tracer.start_span(
+                "serving.microbatch", parent=live[0].span,
+                batch_size=len(live), shed=len(batch) - len(live),
+                member_traces=[r.span.trace_id for r in live
+                               if r.span is not None])
+        return live
 
     def _shed_expired(self, batch: List[Request],
                       now: float) -> List[Request]:
@@ -173,6 +204,7 @@ class DynamicBatcher:
                 except InvalidStateError:
                     pass  # client cancel() raced us; never kill the
                     # dispatcher over an already-settled future
+                r.finish_span("shed")
             else:
                 live.append(r)
         if len(live) < len(batch):
@@ -196,5 +228,6 @@ class DynamicBatcher:
                                               "dispatch"))
                     except InvalidStateError:
                         pass  # client cancel() raced the close
+                    r.finish_span("closed")
                 self.metrics.gauge("serving.queue_depth", 0.0)
             self._cond.notify_all()

@@ -30,6 +30,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sparkdl_tpu.obs.exemplar import ExemplarReservoir
+from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
 from sparkdl_tpu.serving.errors import (DispatchTimeoutError,
                                         ServerClosedError)
@@ -135,6 +137,12 @@ def _settle_error(requests: Sequence[Request], exc: BaseException) -> None:
                 r.future.set_exception(exc)
             except InvalidStateError:  # lost a race with the watchdog
                 pass
+        r.finish_span("error")
+    if requests:
+        bs = requests[0].batch_span
+        if bs is not None:
+            requests[0].batch_span = None
+            bs.finish("error")
 
 
 class Server:
@@ -227,6 +235,9 @@ class Server:
         self._batcher = DynamicBatcher(
             max_batch_size=self.max_batch_size, max_wait_ms=max_wait_ms,
             max_queue=max_queue, metrics=self.metrics)
+        # Slow-request exemplars: top-K span trees, surfaced by varz();
+        # inert (offer() returns False) unless SPARKDL_TRACE is on.
+        self.exemplars = ExemplarReservoir(k=4)
         self._closed = False
         self._abandon = threading.Event()
         self._inflight = 0
@@ -306,8 +317,18 @@ class Server:
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         req = Request(example, deadline)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # root span of this request's trace: submit -> future settle
+            req.span = tracer.start_span(
+                "serving.request",
+                timeout_ms=None if timeout_s is None else timeout_s * 1e3)
         self.metrics.incr("serving.requests")
-        self._batcher.submit(req)
+        try:
+            self._batcher.submit(req)
+        except BaseException:
+            req.finish_span("rejected")
+            raise
         return req.future
 
     def predict(self, example: Any,
@@ -427,12 +448,20 @@ class Server:
             # would otherwise eat any production-sized dispatch timeout
             eng(jax.tree_util.tree_map(np.zeros_like, stacked))
             self._warm.add(bucket)
+        tracer = get_tracer()
+        batch_span = requests[0].batch_span
+        if batch_span is not None:
+            batch_span.annotate(bucket=bucket)
         t0 = time.monotonic()
-        out = with_retries(
-            lambda: self._guarded_call(eng, stacked, requests, finish),
-            max_retries=self._max_retries,
-            non_retryable=NON_RETRYABLE,
-            backoff_seconds=self._retry_backoff_s)
+        # re-root this worker thread onto the micro-batch span so the
+        # engine's own spans (engine.call -> engine.dispatch) nest under
+        # serving.request -> serving.microbatch
+        with tracer.use(batch_span):
+            out = with_retries(
+                lambda: self._guarded_call(eng, stacked, requests, finish),
+                max_retries=self._max_retries,
+                non_retryable=NON_RETRYABLE,
+                backoff_seconds=self._retry_backoff_s)
         batch_s = time.monotonic() - t0
         self._batcher.batch_seconds_hint = batch_s
         self.metrics.incr("serving.batches")
@@ -440,6 +469,8 @@ class Server:
         self.metrics.observe("serving.batch_fill_ratio",
                              n / eng.device_batch_size)
         done = time.monotonic()
+        slowest: Optional[Request] = None
+        slowest_s = 0.0
         for i, r in enumerate(requests):
             if r.future.done():
                 continue  # watchdog raced us; result discarded
@@ -450,10 +481,27 @@ class Server:
             try:
                 r.future.set_result(row)
                 self.metrics.incr("serving.completed")
+                latency_s = done - r.enqueued_at
                 self.metrics.record_time("serving.request_latency",
-                                         done - r.enqueued_at)
+                                         latency_s)
+                if latency_s >= slowest_s:
+                    slowest, slowest_s = r, latency_s
             except InvalidStateError:
                 pass
+        # close the micro-batch span BEFORE the request roots so every
+        # child window sits inside its parent's, then capture exemplars
+        # (offer is a float compare unless this batch holds a new top-K
+        # outlier; a no-op with tracing off)
+        if batch_span is not None:
+            requests[0].batch_span = None
+            batch_span.finish()
+        slow_trace = (slowest.span.trace_id
+                      if slowest is not None and slowest.span is not None
+                      else None)
+        for r in requests:
+            r.finish_span()
+        if slow_trace is not None:
+            self.exemplars.offer(slowest_s, slow_trace, tracer)
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -467,9 +515,49 @@ class Server:
         """Snapshot of the serving metrics (counters, gauges, latency
         p50/p99 — see ``utils.metrics.Metrics.summary``), plus any
         ``pipeline.*`` stage metrics the shared engines recorded."""
+        summary = self.metrics.summary()  # ONE aggregation pass
+        return {k: v for k, v in summary.items()
+                if k.startswith(("serving.", "engine_", "pipeline."))}
+
+    def varz(self) -> Dict[str, Any]:
+        """The ``/varz``-shaped structured form of :meth:`stats`: nested
+        sections instead of flat dotted keys, plus server config/state,
+        the full metrics snapshot (stable schema —
+        ``obs.export.metrics_snapshot``), and the slow-request exemplars
+        (full span trees of the slowest requests; populated only while
+        ``SPARKDL_TRACE`` tracing is on).  JSON-serializable throughout:
+        ``json.dumps(srv.varz())`` IS the monitoring endpoint body."""
+        from sparkdl_tpu.obs.export import metrics_snapshot
+
         m = self.metrics
-        return {**m.subset("serving."), **m.subset("engine_"),
-                **m.subset("pipeline.")}
+
+        def dist_ms(name: str) -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for q, key in ((50, "p50_ms"), (99, "p99_ms")):
+                v = m.percentile(name, q, kind="timing")
+                if v is not None:
+                    out[key] = round(v * 1e3, 3)
+            return out
+
+        snap = metrics_snapshot(m)
+        return {
+            "server": {
+                "closed": self._closed,
+                "max_batch_size": self.max_batch_size,
+                "bucket_sizes": list(self._buckets),
+                "queue_depth": self.queue_depth(),
+                "inflight_batches": self._inflight,
+            },
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("serving.")},
+            "latency_ms": {
+                "request": dist_ms("serving.request_latency"),
+                "batch": dist_ms("serving.batch_latency"),
+                "queue": dist_ms("serving.time_in_queue"),
+            },
+            "metrics": snap,
+            "exemplars": self.exemplars.snapshot(),
+        }
 
     def close(self, drain: bool = True,
               timeout_s: Optional[float] = 30.0) -> None:

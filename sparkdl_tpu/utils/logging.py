@@ -3,6 +3,13 @@
 The reference only used ad-hoc ``logging`` warnings; SURVEY.md §5 flags
 observability as a gap to fill — this gives every subsystem a namespaced
 logger with one consistent format.
+
+Trace correlation: when span tracing is active (``SPARKDL_TRACE``,
+:mod:`sparkdl_tpu.obs.trace`), every record emitted from inside a span
+carries that span's trace id (`` trace=t0000af``) so log lines from the
+admission thread, dispatch workers, and pipeline stages join up with
+the trace artifacts.  With tracing off the hook is one global read per
+record and the format is unchanged.
 """
 
 from __future__ import annotations
@@ -10,9 +17,27 @@ from __future__ import annotations
 import logging
 import os
 
-# %(name)s is the full dotted logger name (already sparkdl_tpu-prefixed).
-_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+# %(name)s is the full dotted logger name (already sparkdl_tpu-prefixed);
+# %(trace)s is "" or " trace=<id>" (injected by _TraceContextFilter).
+_FORMAT = "%(asctime)s %(levelname)s %(name)s%(trace)s: %(message)s"
 _configured = False
+
+
+class _TraceContextFilter(logging.Filter):
+    """Stamps each record with the calling thread's current trace id
+    (empty when tracing is disabled or no span is open).  Imports the
+    tracer lazily so logging never drags ``obs`` in at import time."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tid = None
+        try:
+            from sparkdl_tpu.obs.trace import current_trace_id
+
+            tid = current_trace_id()
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+        record.trace = f" trace={tid}" if tid else ""
+        return True
 
 
 def _configure_root():
@@ -27,6 +52,7 @@ def _configure_root():
         level = "INFO"
     handler = logging.StreamHandler()
     handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.addFilter(_TraceContextFilter())
     root = logging.getLogger("sparkdl_tpu")
     root.addHandler(handler)
     root.setLevel(level)

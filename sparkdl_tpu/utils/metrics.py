@@ -76,15 +76,50 @@ class Metrics:
         k = max(0, min(len(vs) - 1, math.ceil(q / 100.0 * len(vs)) - 1))
         return vs[k]
 
-    def percentile(self, name: str, q: float) -> Optional[float]:
+    def percentile(self, name: str, q: float,
+                   kind: Optional[str] = None) -> Optional[float]:
         """Percentile of a timing or histogram series; None when the
-        series is absent/empty."""
+        series is absent/empty.
+
+        Name-collision contract (a name living in BOTH families):
+        lookup is EXPLICIT and deterministic — ``kind="timing"`` /
+        ``kind="histogram"`` selects a family outright; with
+        ``kind=None`` (default) a name PRESENT in ``timings_s`` always
+        resolves to the timing series, even when that series is
+        currently empty (historically an empty timing list fell through
+        to a same-named histogram via ``or``-short-circuit, so the
+        answer flipped family with buffer occupancy)."""
         with self._lock:
-            series = self.timings_s.get(name) or self.histograms.get(name)
+            if kind == "timing":
+                series = self.timings_s.get(name)
+            elif kind == "histogram":
+                series = self.histograms.get(name)
+            elif kind is not None:
+                raise ValueError(f"kind must be 'timing', 'histogram', "
+                                 f"or None, got {kind!r}")
+            elif name in self.timings_s:  # timings win, even when empty
+                series = self.timings_s[name]
+            else:
+                series = self.histograms.get(name)
             series = list(series) if series else None
         if not series:
             return None
         return self._percentile(series, q)
+
+    def snapshot_raw(self) -> Dict[str, Dict]:
+        """Consistent copies of every family under one lock hold —
+        the raw shape the exporters (``obs.export``) aggregate from:
+        ``{"counters", "gauges", "timings_s", "histograms"}`` with
+        series copied so the caller can iterate without racing
+        writers."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timings_s": {k: list(v) for k, v in self.timings_s.items()},
+                "histograms": {k: list(v)
+                               for k, v in self.histograms.items()},
+            }
 
     def subset(self, prefix: str) -> Dict[str, float]:
         """``summary()`` filtered to keys starting with ``prefix`` — the
