@@ -42,7 +42,13 @@ SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
 SPARKDL_BENCH_REPROBE_TIMEOUT (120), SPARKDL_RELAY_CACHE (last-good
 relay profile path), SPARKDL_BENCH_TRACE (default 1: per-config span
 tracing; each line carries ``metrics_snapshot`` + ``trace_artifact``),
-SPARKDL_BENCH_TRACE_DIR (artifact dir, default artifacts/bench_traces).
+SPARKDL_BENCH_TRACE_DIR (artifact dir, default artifacts/bench_traces),
+SPARKDL_BENCH_ARTIFACT (crash-safe JSONL rider, default
+artifacts/bench_lines.jsonl: every printed line is fsync-appended so a
+killed run still leaves valid JSONL for every completed config — the
+no-more-empty-BENCH_*.json contract), SPARKDL_FAULTS (fault injection;
+every line is stamped ``faults: none|<spec>`` so chaos runs can never
+pass as clean perf numbers).
 
 Dead-relay behavior: a failed start-of-run probe no longer blanks the
 whole run — the chip-independent configs run FIRST (their lines are
@@ -193,10 +199,28 @@ def _end_config_obs(key: str) -> None:
 _LINES = {}
 _LAST_PRINTED = [None]
 
+# Crash-safe driver artifact (ISSUE 4): round-5's dead relay produced an
+# EMPTY BENCH_r05.json because the only record of completed configs was
+# the driver's stdout capture, gone when the process was killed mid-run.
+# Every printed line is now ALSO appended to an on-disk JSONL artifact
+# with an fsync per record (utils.jsonl.CrashSafeJsonlWriter), so a
+# SIGKILL at any instant leaves valid JSONL for every config that
+# completed.  ``SPARKDL_BENCH_ARTIFACT`` overrides the path; a read-only
+# checkout disables the writer rather than failing the bench.
+from sparkdl_tpu.utils.jsonl import CrashSafeJsonlWriter
+
+ARTIFACT_PATH = os.environ.get(
+    "SPARKDL_BENCH_ARTIFACT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "artifacts", "bench_lines.jsonl"))
+
+_ARTIFACT = CrashSafeJsonlWriter(ARTIFACT_PATH)
+
 
 def _print_line(line):
     _LAST_PRINTED[0] = line
     print(line, flush=True)
+    _ARTIFACT.write_line(line)
 
 
 def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
@@ -212,6 +236,8 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
     serving config's p50/p99 latency) without touching the core keys."""
     denom, basis = v100_baseline(baseline_model) if baseline_model else (
         None, None)
+    from sparkdl_tpu.faults import current_spec
+
     rec = {
         "config": config, "metric": metric, "value": round(float(value), 2),
         "unit": unit,
@@ -220,6 +246,10 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
         "baseline": ({"ips": round(denom, 1), "basis": basis}
                      if denom is not None else None),
         "env_bound": env_bound,
+        # chaos stamp: a bench line produced under fault injection must
+        # never be mistaken for a clean perf number — the active plan's
+        # canonical SPARKDL_FAULTS spec, or "none"
+        "faults": current_spec() or "none",
     }
     if basis is not None and basis.startswith("flop-scaled"):
         rec["vs_sourced_anchor"] = round(float(value) / V100_BASELINE_IPS, 3)
@@ -364,7 +394,22 @@ def measure_relay_profile(timeout_s: int = 240):
     Runs in a SUBPROCESS with a timeout: a dead/hung relay blocks inside
     native transfer calls that Python cannot interrupt, and the bench
     must emit an explicit unreachable-diagnostic line rather than hang
-    silently until the driver kills it."""
+    silently until the driver kills it.
+
+    Fault site ``bench.relay_probe``: an ``error`` rule re-raises as the
+    probe's own ``subprocess.TimeoutExpired``, driving the REAL
+    dead-relay machinery (skip lines, chipless-first ordering, bounded
+    re-probes) without a dead relay; a ``sleep`` rule is a slow relay.
+    """
+    import subprocess
+
+    from sparkdl_tpu.faults import InjectedFault, inject
+
+    try:
+        inject("bench.relay_probe")
+    except InjectedFault as e:
+        raise subprocess.TimeoutExpired(
+            cmd=f"<injected dead relay: {e}>", timeout=timeout_s) from e
     return _run_json_subprocess(_RELAY_PROBE, timeout_s)
 
 
@@ -847,6 +892,7 @@ def main():
     # complete run.
     import subprocess
 
+    _ARTIFACT.reset()  # fresh crash-safe JSONL rider for this run
     relay_dead = False
     try:
         RELAY.update(measure_relay_profile())

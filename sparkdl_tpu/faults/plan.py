@@ -1,0 +1,272 @@
+"""Deterministic, seeded fault-injection plans + the ``inject`` hook.
+
+The chaos analog of ``SPARKDL_TRACE``: hot paths call
+:func:`inject("site")` at named injection points; with no plan active
+that is ONE module-global read and a ``None`` check (near-zero, same
+budget as the tracer's disabled path), and with a plan active the
+site's rules decide — deterministically, from the plan seed and the
+site's call counter — whether to raise, stall, or mark the site dead.
+
+Determinism contract: given the same ``(seed, spec)`` and the same
+per-site call ORDER, a plan replays the identical firing sequence.
+Probabilistic rules (``p=``) draw from a per-rule ``random.Random``
+seeded from ``(seed, site, rule index)``, never from global state, so
+two plans with the same spec fire identically even when other code
+consumes the global RNG in between.
+
+Thread model: ``fire`` takes the plan lock (counters + RNG draws are
+shared state); injection sites sit on paths where a lock per call is
+noise next to the device/decode work around them, and the DISABLED
+path — the only one production traffic sees — takes no lock at all.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+from sparkdl_tpu.faults.errors import (InjectedDeadDeviceError,
+                                       InjectedDecodeError, InjectedFault,
+                                       InjectedFatalError,
+                                       InjectedTransientError)
+from sparkdl_tpu.faults.spec import (FaultRule, faults_from_env, format_spec,
+                                     parse_spec)
+
+_EXC_BY_KIND = {
+    "transient": InjectedTransientError,
+    "fatal": InjectedFatalError,
+    "dead": InjectedDeadDeviceError,
+    "decode": InjectedDecodeError,
+}
+
+
+def _make_exc(kind: str, message: str, site: str, rule: str,
+              retry_after_s: float) -> BaseException:
+    if kind == "queue_full":
+        # Lazy import: faults is a leaf layer the serving stack imports;
+        # the reverse edge exists only when a queue_full rule fires.
+        from sparkdl_tpu.serving.errors import QueueFullError
+
+        exc = QueueFullError(message, retry_after_s=retry_after_s)
+        exc.site = site  # type: ignore[attr-defined]
+        exc.rule = rule  # type: ignore[attr-defined]
+        return exc
+    return _EXC_BY_KIND[kind](message, site=site, rule=rule)
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultRule` s with per-rule firing state.
+
+    Construct directly in tests (``FaultPlan([FaultRule(...)], seed=7)``
+    or from a spec string (``FaultPlan.parse("seed=7;engine.dispatch:"
+    "error:at=2")``), then :func:`configure` it — or use the
+    :func:`active` context manager, which restores the previous plan on
+    exit.
+    """
+
+    def __init__(self, rules: Sequence[Union[FaultRule, str]] = (),
+                 seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = []
+        for r in rules:
+            if isinstance(r, str):
+                embedded_seed, parsed = parse_spec(r)
+                if embedded_seed:
+                    # a "seed=N;..." clause inside a rule string must
+                    # mean what it means in parse(): determinism parity
+                    # between the two construction forms
+                    self.seed = embedded_seed
+                self.rules.extend(parsed)
+            else:
+                self.rules.append(r)
+        self._lock = threading.Lock()
+        self._site_calls: Dict[str, int] = {}
+        self._fired: Dict[int, int] = {}       # rule index -> firings
+        self._sticky_dead: Dict[str, str] = {}  # site -> clause that died
+        import random
+
+        self._rngs = [random.Random(f"{self.seed}:{r.site}:{i}")
+                      for i, r in enumerate(self.rules)]
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        seed, rules = parse_spec(spec)
+        return cls(rules, seed=seed)
+
+    @property
+    def spec(self) -> str:
+        """Canonical spec string (round-trips through :meth:`parse`)."""
+        return format_spec(self.seed, self.rules)
+
+    # -- introspection -----------------------------------------------------
+    def sites(self) -> set:
+        return {r.site for r in self.rules}
+
+    def has_rules(self, site: str) -> bool:
+        return any(r.site == site for r in self.rules)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-site ``{"calls": N, "fired": N}`` — what chaos tests
+        assert to prove the planned faults actually fired."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for site, calls in self._site_calls.items():
+                out[site] = {"calls": calls, "fired": 0}
+            for i, r in enumerate(self.rules):
+                if self._fired.get(i):
+                    out.setdefault(r.site, {"calls": 0, "fired": 0})
+                    out[r.site]["fired"] += self._fired[i]
+            return out
+
+    def fired(self, site: Optional[str] = None) -> int:
+        """Total rule firings (optionally for one site)."""
+        with self._lock:
+            return sum(n for i, n in self._fired.items()
+                       if site is None or self.rules[i].site == site)
+
+    # -- the hot hook ------------------------------------------------------
+    def fire(self, site: str, ctx: Dict[str, Any]) -> None:
+        """Advance ``site``'s call counter and run any due rules: raise
+        (``error``/``dead``), stall (``sleep``, then keep evaluating), or
+        pass.  Called only while the plan is configured."""
+        sleep_s = 0.0
+        raise_exc: Optional[BaseException] = None
+        with self._lock:
+            n = self._site_calls.get(site, 0) + 1
+            self._site_calls[site] = n
+            dead_clause = self._sticky_dead.get(site)
+            if dead_clause is not None:
+                raise_exc = InjectedDeadDeviceError(
+                    f"injected dead device at {site} (sticky since rule "
+                    f"[{dead_clause}] fired; call #{n})",
+                    site=site, rule=dead_clause)
+            else:
+                for i, r in enumerate(self.rules):
+                    if r.site != site:
+                        continue
+                    if not self._due(i, r, n):
+                        continue
+                    self._fired[i] = self._fired.get(i, 0) + 1
+                    msg = (f"injected {r.action} fault at {site} "
+                           f"(rule [{r.clause}], call #{n})")
+                    if r.action == "sleep":
+                        sleep_s += float(r.params.get("ms", 100.0)) / 1e3
+                        continue
+                    if r.action == "dead":
+                        self._sticky_dead[site] = r.clause
+                        raise_exc = InjectedDeadDeviceError(
+                            msg, site=site, rule=r.clause)
+                        break
+                    kind = r.params.get("exc", "transient")
+                    raise_exc = _make_exc(
+                        kind, msg, site, r.clause,
+                        retry_after_s=float(r.params.get("retry_after",
+                                                         0.05)))
+                    break
+        if sleep_s:
+            time.sleep(sleep_s)
+        if raise_exc is not None:
+            raise raise_exc
+
+    def _due(self, i: int, r: FaultRule, n: int) -> bool:
+        """Schedule evaluation for rule ``i`` at site call ``n`` — caller
+        holds the lock."""
+        times = r.params.get("times")
+        if times is not None and self._fired.get(i, 0) >= int(times):
+            return False
+        at = r.params.get("at")
+        if at is not None and n != int(at):
+            return False
+        every = r.params.get("every")
+        if every is not None and n % max(1, int(every)) != 0:
+            return False
+        p = r.params.get("p")
+        if p is not None and self._rngs[i].random() >= float(p):
+            return False
+        return True
+
+
+# -- module singleton (the SPARKDL_TRACE pattern) --------------------------
+_UNSET = object()   # before the first inject() consults SPARKDL_FAULTS
+_PLAN: Any = _UNSET
+_PLAN_LOCK = threading.Lock()
+
+
+def inject(site: str, **ctx: Any) -> None:
+    """The injection hook hot paths call at a named site.
+
+    Disabled path (no plan configured, ``SPARKDL_FAULTS`` unset): one
+    global read + identity check + return — guarded by the run-tests.sh
+    overhead stage.  The env var is consulted exactly once, on the first
+    call, after which the global is either a plan or ``None``.
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    if plan is _UNSET:
+        plan = configure_from_env()
+        if plan is None:
+            return
+    plan.fire(site, ctx)
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active plan (resolving the env on first ask), or None."""
+    plan = _PLAN
+    if plan is _UNSET:
+        return configure_from_env()
+    return plan
+
+
+def has_rules(site: str) -> bool:
+    """True iff an active plan has rules for ``site`` — the cheap query
+    call sites use to route around fast paths the injection point cannot
+    reach (e.g. the native decode core)."""
+    plan = get_plan()
+    return plan is not None and plan.has_rules(site)
+
+
+def configure(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` as the process fault plan (None disables)."""
+    global _PLAN
+    with _PLAN_LOCK:
+        _PLAN = plan
+    return plan
+
+
+def clear() -> None:
+    """Disable injection (and stop consulting the env until
+    :func:`configure_from_env` is called again)."""
+    configure(None)
+
+
+def configure_from_env() -> Optional[FaultPlan]:
+    """(Re-)configure from ``SPARKDL_FAULTS``; returns the plan or None
+    when the variable is unset/empty."""
+    raw = faults_from_env()
+    return configure(FaultPlan.parse(raw) if raw else None)
+
+
+def current_spec() -> Optional[str]:
+    """Canonical spec of the active plan (bench lines stamp this as
+    ``faults``), or None when injection is off."""
+    plan = get_plan()
+    return plan.spec if plan is not None else None
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Scope ``plan`` to a ``with`` block, restoring whatever was
+    configured before (the test-suite idiom)."""
+    global _PLAN
+    with _PLAN_LOCK:
+        prev = _PLAN
+        _PLAN = plan
+    try:
+        yield plan
+    finally:
+        with _PLAN_LOCK:
+            _PLAN = prev

@@ -140,8 +140,20 @@ def decodeResizeBatch(blobs: Sequence[bytes], height: int, width: int
     Uses the native threaded core (libjpeg DCT prescale + libpng) when
     available and useful; PIL otherwise.  Undecodable rows: ok=False,
     zeroed pixels (drop-to-null upstream).
+
+    Fault site ``io.decode`` (per row, :mod:`sparkdl_tpu.faults`): an
+    injected decode error mid-stream must ride the SAME drop-to-null
+    contract as a genuinely corrupt blob — the row's ok flag goes False
+    and the stream continues.  A plan with ``io.decode`` rules routes
+    around the native core AND the decode thread pool, so the per-row
+    site is reached in deterministic row order (``at=``/``every=``
+    schedules count calls; pool scheduling would make the dropped row
+    arbitrary).
     """
-    if _native_io_preferred():
+    from sparkdl_tpu import faults as _faults
+
+    io_faults = _faults.has_rules("io.decode")
+    if not io_faults and _native_io_preferred():
         import sparkdl_tpu.native as native
 
         result = native.decode_resize_batch(blobs, height, width)
@@ -152,6 +164,10 @@ def decodeResizeBatch(blobs: Sequence[bytes], height: int, width: int
 
     def one(i_blob):
         i, blob = i_blob
+        try:
+            _faults.inject("io.decode", row=i)
+        except _faults.InjectedFault:
+            return  # simulated corrupt row: ok stays False (drop-to-null)
         arr = PIL_decode(blob)  # BGR or None
         if arr is None:
             return
@@ -160,7 +176,7 @@ def decodeResizeBatch(blobs: Sequence[bytes], height: int, width: int
         out[i] = resizeImage(arr, height, width)[:, :, ::-1]
         ok[i] = True
 
-    if len(blobs) >= 4:
+    if len(blobs) >= 4 and not io_faults:
         list(_io_executor().map(one, enumerate(blobs)))
     else:
         for pair in enumerate(blobs):

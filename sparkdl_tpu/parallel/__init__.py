@@ -9,14 +9,22 @@ instead of Spark shuffle/broadcast.
 
 from sparkdl_tpu.parallel.mesh import (batch_sharding, get_mesh,
                                        replicated_sharding)
-from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.parallel.engine import (CircuitOpenError,
+                                         DispatchCircuitBreaker,
+                                         InferenceEngine)
 from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
+                                           PipelineStageError,
+                                           PipelineStageFatalError,
                                            pipeline_enabled_from_env)
 from sparkdl_tpu.parallel import distributed
 
 __all__ = [
+    "CircuitOpenError",
+    "DispatchCircuitBreaker",
     "InferenceEngine",
     "PipelinedRunner",
+    "PipelineStageError",
+    "PipelineStageFatalError",
     "batch_sharding",
     "distributed",
     "get_mesh",

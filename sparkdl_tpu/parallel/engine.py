@@ -25,18 +25,162 @@ Throughput design:
 
 from __future__ import annotations
 
+import threading
+import time as time_lib
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.parallel import mesh as mesh_lib
 from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
                                            pipeline_enabled_from_env)
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
+from sparkdl_tpu.utils.retry import NON_RETRYABLE, with_retries
 
 logger = get_logger(__name__)
+
+
+class CircuitOpenError(RuntimeError):
+    """The engine's dispatch circuit breaker is OPEN: ``breaker_threshold``
+    consecutive device errors tripped it, and dispatches now fail fast
+    (with the last device error's text) instead of each paying a full
+    retry-with-backoff budget against a dead device.  ``retry_after_s``
+    is the remaining cool-down before a half-open trial dispatch is
+    allowed."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 last_error: Optional[str] = None):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.last_error = last_error
+
+
+class DispatchCircuitBreaker:
+    """Consecutive-failure circuit breaker for device dispatch.
+
+    closed --(threshold consecutive failures)--> open
+    open   --(cooldown elapses)-->                half_open (ONE trial)
+    half_open --success--> closed; --failure--> open (fresh cooldown)
+
+    Deterministic errors (``utils.retry.NON_RETRYABLE`` — shape/param
+    validation, NaN fail-fast) never count: they indicate a caller bug,
+    not a dying device, and must keep failing loudly per call.
+    ``threshold <= 0`` disables the breaker entirely (gate/record are
+    no-ops without taking the lock — the default-path budget).
+    """
+
+    def __init__(self, threshold: int = 8, cooldown_s: float = 30.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._open_until = 0.0
+        self._open = False
+        self._trial_inflight = False
+        self._last_error: Optional[str] = None
+        self._opened_count = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def gate(self) -> None:
+        """Fail fast with :class:`CircuitOpenError` while open; admit a
+        single trial dispatch once the cool-down elapses (half-open)."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            if not self._open:
+                return
+            now = time_lib.monotonic()
+            remaining = self._open_until - now
+            if remaining > 0 or self._trial_inflight:
+                raise CircuitOpenError(
+                    f"dispatch circuit breaker open "
+                    f"({self._consecutive} consecutive device errors; "
+                    f"last: {self._last_error}); failing fast — retry in "
+                    f"{max(0.0, remaining):.2f}s",
+                    retry_after_s=max(0.0, remaining),
+                    last_error=self._last_error)
+            self._trial_inflight = True  # half-open: this caller probes
+
+    def record_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive = 0
+            self._open = False
+            self._trial_inflight = False
+
+    def release_trial(self) -> None:
+        """Give back a half-open trial slot WITHOUT judging the device
+        (the attempt died on a deterministic caller error, which proves
+        nothing about device health).  The breaker stays open, but the
+        next gate() may admit a fresh trial — without this, a
+        NON_RETRYABLE error during the trial would pin ``_trial_inflight``
+        and leave the breaker open forever."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._trial_inflight = False
+
+    def record_failure(self, exc: BaseException) -> bool:
+        """Count a device error; returns True when this failure OPENED
+        (or re-opened) the breaker."""
+        if self.threshold <= 0 or isinstance(exc, NON_RETRYABLE):
+            return False
+        with self._lock:
+            self._consecutive += 1
+            was_trial = self._trial_inflight
+            self._trial_inflight = False
+            self._last_error = f"{type(exc).__name__}: {exc}"
+            if was_trial or (not self._open
+                             and self._consecutive >= self.threshold):
+                self._open = True
+                self._open_until = time_lib.monotonic() + self.cooldown_s
+                self._opened_count += 1
+                return True
+            return False
+
+    def open_remaining_s(self) -> Optional[float]:
+        """Remaining cool-down if OPEN, else None — the cheap per-submit
+        query (one lock, no snapshot dict) the serving admission path
+        uses; half-open reports None so trial traffic is admitted."""
+        if self.threshold <= 0:
+            return None
+        with self._lock:
+            if not self._open:
+                return None
+            remaining = self._open_until - time_lib.monotonic()
+            if remaining <= 0 and not self._trial_inflight:
+                return None  # half-open: let the trial through
+            return max(0.0, remaining)
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable breaker snapshot (``Server.health`` /
+        ``varz`` surface this per bucket engine)."""
+        with self._lock:
+            now = time_lib.monotonic()
+            if not self._open:
+                st = "closed"
+            elif now < self._open_until or self._trial_inflight:
+                st = "open"
+            else:
+                st = "half_open"
+            return {
+                "state": st,
+                "enabled": self.threshold > 0,
+                "consecutive_failures": self._consecutive,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "retry_after_s": (round(max(0.0, self._open_until - now), 3)
+                                  if st == "open" else 0.0),
+                "opened_count": self._opened_count,
+                "last_error": self._last_error,
+            }
 
 
 # Module-level compiled-program cache: engines built around the SAME model
@@ -111,6 +255,14 @@ class InferenceEngine:
                  output_host_dtype: Optional[Any] = None,
                  donate_batch: bool = False,
                  batches_per_dispatch: int = 1,
+                 dispatch_retries: int = 0,
+                 dispatch_backoff_s: float = 0.05,
+                 dispatch_max_backoff_s: float = 2.0,
+                 dispatch_jitter: float = 0.25,
+                 breaker_threshold: int = 8,
+                 breaker_cooldown_s: float = 30.0,
+                 on_dispatch_error: Optional[
+                     Callable[[BaseException], None]] = None,
                  metrics: Optional[Metrics] = None):
         import jax
 
@@ -155,6 +307,22 @@ class InferenceEngine:
         # relayed links — PERF.md).  None = return outputs as produced.
         self.output_host_dtype = (np.dtype(output_host_dtype)
                                   if output_host_dtype is not None else None)
+
+        # Failure domain (ISSUE 4): bounded retry-with-backoff for
+        # TRANSIENT dispatch faults (jittered + capped via utils.retry —
+        # the Spark task-retry analog at dispatch granularity; default 0
+        # = fail fast, callers opt in) and a consecutive-failure circuit
+        # breaker so a STICKY-dead device fails fast with a clear error
+        # instead of paying the full retry budget per call forever.
+        # ``on_dispatch_error`` fires on every failed ATTEMPT (even ones
+        # a retry later absorbs) — the serving layer's health() hook.
+        self.dispatch_retries = max(0, int(dispatch_retries))
+        self.dispatch_backoff_s = max(0.0, float(dispatch_backoff_s))
+        self.dispatch_max_backoff_s = float(dispatch_max_backoff_s)
+        self.dispatch_jitter = float(dispatch_jitter)
+        self.breaker = DispatchCircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s)
+        self._on_dispatch_error = on_dispatch_error
 
         # k host batches per compiled dispatch (lax.map over a stacked
         # leading group axis): one launch + one result fetch per k batches
@@ -223,6 +391,110 @@ class InferenceEngine:
                              "(batch) axis length")
         return n
 
+    def _attempt_dispatch(self, thunk):
+        """ONE gated dispatch attempt: breaker gate -> fault-injection
+        site -> H2D + launch; success/failure feed the breaker and the
+        ``on_dispatch_error`` health hook.  Deterministic errors
+        (``NON_RETRYABLE``) bypass the breaker count — they are caller
+        bugs, not device state."""
+        self.breaker.gate()
+        try:
+            inject("engine.dispatch")
+            out = thunk()
+        except NON_RETRYABLE:
+            # deterministic caller error: not device evidence either way
+            # — but a half-open trial slot must be handed back, or the
+            # breaker could never re-probe
+            self.breaker.release_trial()
+            raise
+        except BaseException as e:  # noqa: BLE001 — device/runtime error
+            self._charge_breaker(e, "engine.dispatch_errors")
+            raise
+        # NOTE: success is NOT recorded here.  Dispatch is an async
+        # ENQUEUE — a dying device usually raises when the result is
+        # forced (D2H), so the attempt is only known good at force time
+        # (_force_parts), which records the breaker success.
+        return out
+
+    def _charge_breaker(self, e: BaseException, counter: str) -> None:
+        """Shared failure bookkeeping for both failure surfaces of an
+        async dispatch (the enqueue attempt and the result force):
+        metrics, breaker count, open log line, and the health hook."""
+        self.metrics.incr(counter)
+        if self.breaker.record_failure(e):
+            self.metrics.incr("engine.breaker_opened")
+            logger.warning(
+                "dispatch circuit breaker OPENED after %d consecutive "
+                "device errors (last: %s: %s); failing fast for %.1fs",
+                self.breaker.state()["consecutive_failures"],
+                type(e).__name__, e, self.breaker.cooldown_s)
+        if self._on_dispatch_error is not None:
+            self._on_dispatch_error(e)
+
+    def _force_parts(self, ns, out, block=None):
+        """Force one in-flight dispatch to host row batch(es) — the D2H
+        fetch + trim shared verbatim by the serial drain and the
+        pipelined gather stage (``ns`` int = plain piece; tuple = a
+        grouped dispatch, fetched once and sliced host-side).
+
+        This is the OTHER failure surface of an async dispatch: jax's
+        enqueue returns before the device runs, so a dying device
+        typically raises here, not in ``_attempt_dispatch`` — errors are
+        charged to the same breaker/health accounting (no retry: a
+        failed force cannot be re-run without re-dispatching), and a
+        successful force is what records breaker success.  ``block``
+        (the gather span's ``block_until_ready``) forces device
+        completion inside the caller's span so device wait stays
+        attributed."""
+        import jax
+
+        try:
+            inject("engine.gather")
+            if block is not None:
+                block(out)
+            if isinstance(ns, int):
+                parts = [self._trim(out, ns)]
+            else:
+                # one D2H fetch for the whole group, sliced on the host
+                # (per-batch device slicing would pay k fetch round
+                # trips — the latency the grouping exists to amortize)
+                host = jax.tree_util.tree_map(np.asarray, out)
+                parts = [self._trim(jax.tree_util.tree_map(
+                    lambda a, i=i: a[i], host), n)
+                    for i, n in enumerate(ns)]
+        except NON_RETRYABLE:
+            self.breaker.release_trial()
+            raise
+        except BaseException as e:  # noqa: BLE001 — device/runtime error
+            self._charge_breaker(e, "engine.gather_errors")
+            raise
+        self.breaker.record_success()
+        return parts
+
+    def _run_dispatch(self, thunk):
+        """Dispatch with the engine's transient-fault retry budget:
+        ``dispatch_retries`` re-executions with jittered, capped
+        exponential backoff (``utils.retry``).  Deterministic failures
+        and a breaker that opened mid-budget fail immediately."""
+        if self.dispatch_retries <= 0:
+            return self._attempt_dispatch(thunk)
+
+        def on_retry(attempt, exc):
+            self.metrics.incr("engine.dispatch_retries")
+
+        return with_retries(
+            lambda: self._attempt_dispatch(thunk),
+            max_retries=self.dispatch_retries,
+            non_retryable=NON_RETRYABLE + (CircuitOpenError,),
+            backoff_seconds=self.dispatch_backoff_s,
+            max_backoff_seconds=self.dispatch_max_backoff_s,
+            jitter=self.dispatch_jitter,
+            on_retry=on_retry)
+
+    def breaker_state(self) -> Dict[str, Any]:
+        """The dispatch circuit breaker's JSON-serializable snapshot."""
+        return self.breaker.state()
+
     def run_padded(self, batch):
         """Run one already-padded device batch (array or pytree of arrays
         sharing the leading batch axis); returns device output(s)."""
@@ -232,13 +504,17 @@ class InferenceEngine:
             raise ValueError(
                 f"run_padded expects batch of {self.device_batch_size}, "
                 f"got {self._leaves(batch)}")
+
         # span covers H2D + async launch only (the call returns as soon
         # as the dispatch is enqueued); the device wait is bracketed by
         # whichever stage forces the result (pipeline.gather / _trim)
-        with get_tracer().span("engine.dispatch",
-                               rows=self.device_batch_size):
-            x = jax.device_put(batch, self._batch_sharding)
-            return self._compiled(self.variables, x)
+        def attempt():
+            with get_tracer().span("engine.dispatch",
+                                   rows=self.device_batch_size):
+                x = jax.device_put(batch, self._batch_sharding)
+                return self._compiled(self.variables, x)
+
+        return self._run_dispatch(attempt)
 
     def _pad(self, chunk):
         import jax
@@ -366,10 +642,14 @@ class InferenceEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sh = NamedSharding(self.mesh, P(None, mesh_lib.DATA_AXIS))
-        with get_tracer().span("engine.dispatch",
-                               group=self.batches_per_dispatch):
-            return self._compiled_group(self.variables,
-                                        jax.device_put(stacked, sh))
+
+        def attempt():
+            with get_tracer().span("engine.dispatch",
+                                   group=self.batches_per_dispatch):
+                return self._compiled_group(self.variables,
+                                            jax.device_put(stacked, sh))
+
+        return self._run_dispatch(attempt)
 
     # -- streaming API -----------------------------------------------------
     def map_batches(self, batches: Iterable[Any], window: int = 2,
@@ -432,8 +712,6 @@ class InferenceEngine:
         piece order and programs, no worker threads."""
         from collections import deque
 
-        import jax
-
         if self.batches_per_dispatch > 1:
             window = max(1, int(window) // self.batches_per_dispatch)
         inflight: deque = deque()
@@ -441,16 +719,7 @@ class InferenceEngine:
         def drain(limit):
             while len(inflight) > limit:
                 ns, out = inflight.popleft()
-                if isinstance(ns, int):
-                    yield self._trim(out, ns)
-                    continue
-                # one D2H fetch for the whole group, sliced on the host
-                # (per-batch device slicing would pay k fetch round trips
-                # — the latency this knob exists to amortize)
-                host = jax.tree_util.tree_map(np.asarray, out)
-                for i, n in enumerate(ns):
-                    yield self._trim(
-                        jax.tree_util.tree_map(lambda a: a[i], host), n)
+                yield from self._force_parts(ns, out)
 
         for kind, ns, host in self._iter_pieces(batches):
             inflight.append((ns, self.run_padded(host) if kind == "plain"

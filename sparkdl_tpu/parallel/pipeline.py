@@ -30,6 +30,14 @@ Contracts:
 ``SPARKDL_PIPELINE=0`` is the escape hatch: every scoring surface
 (``InferenceEngine.map_batches``/``__call__``, the zoo/image/tensor
 transformers, image UDFs, and serving) then runs the serial path.
+
+Failure domain (ISSUE 4): each stage loop carries a fault-injection
+site (``pipeline.prepare`` / ``pipeline.dispatch`` / ``pipeline.gather``
+— :mod:`sparkdl_tpu.faults`), and a stage crash — injected or real —
+cancels the graph, joins every worker with a bounded timeout, and
+re-raises consumer-side as :class:`PipelineStageError` naming the stage
+and piece index, with the original exception chained.  No queue is left
+with a blocked producer/consumer and no thread outlives the run.
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ from typing import Any, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
@@ -50,6 +59,54 @@ logger = get_logger(__name__)
 
 _DONE = object()    # end-of-stream marker flowing through every queue
 _ABORT = object()   # returned by queue helpers when the run was cancelled
+
+
+class PipelineStageError(RuntimeError):
+    """A pipeline worker stage crashed.  Carries the failure DOMAIN —
+    ``stage`` (``prepare``/``dispatch``/``gather``) and ``piece`` (the
+    0-based piece index the stage was working when it died; -1 when it
+    crashed before touching one) — so a production incident names the
+    failing layer instead of surfacing a bare exception from an anonymous
+    daemon thread.  The original exception is chained as ``__cause__``
+    (and echoed in the message, so existing ``pytest.raises(...,
+    match=...)`` callers keep matching); the run is guaranteed to have
+    drained: all three stage threads observed the stop flag and exited
+    before this raises."""
+
+    def __init__(self, stage: str, piece: int, cause: BaseException):
+        super().__init__(
+            f"pipeline {stage} stage failed at piece {piece}: "
+            f"{type(cause).__name__}: {cause}")
+        self.stage = stage
+        self.piece = piece
+
+
+class PipelineStageFatalError(PipelineStageError, ValueError):
+    """The DETERMINISTIC variant: raised when the stage's underlying
+    cause sits in ``utils.retry.NON_RETRYABLE`` (shape/param validation,
+    NaN fail-fast).  Subclassing ``ValueError`` keeps it non-retryable
+    through every ``utils.retry`` wrapper — wrapping a deterministic
+    model bug in a plain RuntimeError would silently re-classify it as
+    transient and burn whole retry budgets reproducing it."""
+
+
+def wrap_stage_error(stage: str, piece: int,
+                     cause: BaseException) -> BaseException:
+    """The consumer-side re-raise policy for a crashed stage: wrap into
+    the structured :class:`PipelineStageError` family — EXCEPT the
+    engine's typed fail-fast signal.  ``CircuitOpenError`` must reach
+    callers unwrapped (its ``retry_after_s``/``last_error`` drive
+    serving shed decisions, and wrapping it in a RuntimeError would turn
+    the breaker's fail-fast back into retryable noise)."""
+    # runtime-only import: engine imports this module at load time
+    from sparkdl_tpu.parallel.engine import CircuitOpenError
+    from sparkdl_tpu.utils.retry import NON_RETRYABLE
+
+    if isinstance(cause, CircuitOpenError):
+        return cause
+    cls = (PipelineStageFatalError if isinstance(cause, NON_RETRYABLE)
+           else PipelineStageError)
+    return cls(stage, piece, cause)
 
 
 def pipeline_enabled_from_env() -> bool:
@@ -121,8 +178,6 @@ class PipelinedRunner:
     def run(self, batches: Iterable[Any]) -> Iterator[Any]:
         """Yield per-piece host outputs, bit-identical to (and in the same
         order as) the serial path."""
-        import jax
-
         eng = self.engine
         m = self.metrics
         stop = threading.Event()
@@ -142,17 +197,21 @@ class PipelinedRunner:
         disp_q: "queue.Queue" = queue.Queue(maxsize=self.window)
         out_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
 
-        def fail(e: BaseException) -> None:
-            errors.append(e)
+        def fail(stage: str, piece: int, e: BaseException) -> None:
+            # first failure wins (later stage crashes are usually the
+            # stop-flag cascade of the first); the consumer re-raises it
+            # as a structured PipelineStageError naming stage + piece
+            errors.append((stage, piece, e))
             stop.set()
 
         def prepare() -> None:
             # the engine's OWN piece iterator (the serial path consumes
             # the same one), so dispatch order is shared by construction
+            idx = 0
             try:
                 src = eng._iter_pieces(batches)
-                idx = 0
                 while True:
+                    inject("pipeline.prepare", piece=idx)
                     with tracer.span("pipeline.prepare", parent=run_span,
                                      piece=idx) as sp:
                         item = next(src, _DONE)
@@ -167,9 +226,10 @@ class PipelinedRunner:
                                      "prep_q"):
                         return
             except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
-                fail(e)
+                fail("prepare", idx, e)
 
         def dispatch() -> None:
+            idx = -1
             try:
                 while True:
                     item = self._get(prep_q, stop, "dispatch")
@@ -177,7 +237,9 @@ class PipelinedRunner:
                         return
                     if item is _DONE:
                         break
+                    idx += 1
                     kind, ns, host = item
+                    inject("pipeline.dispatch", piece=idx)
                     # H2D + async launch: returns as soon as the transfer
                     # is enqueued; the device computes while we loop
                     with tracer.span("pipeline.dispatch",
@@ -190,9 +252,10 @@ class PipelinedRunner:
                         return
                 self._put(disp_q, _DONE, stop, "dispatch", "inflight_q")
             except BaseException as e:  # noqa: BLE001
-                fail(e)
+                fail("dispatch", idx, e)
 
         def gather() -> None:
+            idx = -1
             try:
                 while True:
                     item = self._get(disp_q, stop, "gather")
@@ -200,25 +263,22 @@ class PipelinedRunner:
                         return
                     if item is _DONE:
                         break
+                    idx += 1
                     kind, ns, dev = item
+                    inject("pipeline.gather", piece=idx)
                     # span covers device wait + D2H + trim, NOT the
                     # downstream puts (backpressure is a separate story
                     # told by pipeline.gather_out_stall_s); when tracing
                     # is on, block_until_ready splits device wait
-                    # (device_us) from the host-side copy/cast
+                    # (device_us) from the host-side copy/cast.  The
+                    # force itself is the engine's OWN shared
+                    # _force_parts (identical to the serial drain, and
+                    # the point where force-time device errors charge
+                    # the breaker/health accounting).
                     with tracer.span("pipeline.gather", parent=run_span,
                                      kind=kind) as sp:
-                        sp.block_until_ready(dev)
-                        if kind == "plain":
-                            parts = [eng._trim(dev, ns)]
-                        else:
-                            # one D2H fetch for the whole group, sliced
-                            # on the host (same as the serial drain)
-                            host = jax.tree_util.tree_map(np.asarray, dev)
-                            parts = [
-                                eng._trim(jax.tree_util.tree_map(
-                                    lambda a, i=i: a[i], host), n)
-                                for i, n in enumerate(ns)]
+                        parts = eng._force_parts(
+                            ns, dev, block=sp.block_until_ready)
                     for part in parts:
                         if not self._put(out_q, part, stop, "gather",
                                          "out_q"):
@@ -226,7 +286,7 @@ class PipelinedRunner:
                     m.incr("pipeline.gathers")
                 self._put(out_q, _DONE, stop, "gather", "out_q")
             except BaseException as e:  # noqa: BLE001
-                fail(e)
+                fail("gather", idx, e)
 
         threads = [
             threading.Thread(target=prepare, daemon=True,
@@ -251,17 +311,29 @@ class PipelinedRunner:
                 yield item
         finally:
             # cancels every stage whether we finished, raised, or the
-            # consumer closed the iterator early
+            # consumer closed the iterator early, then ALWAYS joins with
+            # a bounded timeout: a crashed run must hand back a drained
+            # stage graph (no thread blocked on a queue, nothing left to
+            # wedge a later run), not just a stop flag — and when tracing
+            # is on the join also closes stage spans BEFORE their parent
+            # (the child-within-parent invariant tests rely on).  Threads
+            # exit within one 50 ms queue-poll of stop; a thread still
+            # alive after the timeout is a bug worth a loud log line.
             stop.set()
+            for t in threads:
+                t.join(timeout=2.0)
+                if t.is_alive():
+                    logger.warning("pipeline stage thread %s did not exit "
+                                   "within 2s of cancellation", t.name)
             if run_span is not None:
-                # bounded join so stage spans close BEFORE their parent
-                # (the child-within-parent invariant tests rely on);
-                # threads exit within one 50 ms queue-poll of stop
-                for t in threads:
-                    t.join(timeout=2.0)
                 run_span.finish()
         if errors:
-            raise errors[0]
+            stage, piece, cause = errors[0]
+            self.metrics.incr(f"pipeline.{stage}_crashes")
+            err = wrap_stage_error(stage, piece, cause)
+            if err is cause:
+                raise err  # typed pass-through (CircuitOpenError)
+            raise err from cause
 
 
 def pipeline_stage_summary(metrics: Metrics) -> Dict[str, float]:

@@ -18,14 +18,20 @@ Public surface:
 * The error taxonomy: :class:`QueueFullError` (backpressure, carries
   ``retry_after_s``), :class:`DeadlineExceededError` (shed before
   dispatch), :class:`DispatchTimeoutError` (stalled model),
+  :class:`ServiceUnavailableError` (shed at submit while the dispatch
+  circuit breaker is open; carries ``retry_after_s``),
   :class:`ServerClosedError`.
+* ``Server.health()`` — live/ready/degraded with last error, per-bucket
+  circuit-breaker state, and a bounded transition history (also under
+  ``varz()["health"]``); README "Failure model" documents the states.
 """
 
 from sparkdl_tpu.serving.adapters import from_transformer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
 from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError, QueueFullError,
-                                        ServerClosedError, ServingError)
+                                        ServerClosedError,
+                                        ServiceUnavailableError, ServingError)
 from sparkdl_tpu.serving.server import Server
 
 __all__ = [
@@ -37,5 +43,6 @@ __all__ = [
     "QueueFullError",
     "DeadlineExceededError",
     "DispatchTimeoutError",
+    "ServiceUnavailableError",
     "ServerClosedError",
 ]

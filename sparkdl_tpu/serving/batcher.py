@@ -20,6 +20,7 @@ from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, List, Optional
 
+from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import (DeadlineExceededError, QueueFullError,
                                         ServerClosedError)
@@ -114,6 +115,12 @@ class DynamicBatcher:
         with self._cond:
             if self._closed:
                 raise ServerClosedError("server is closed")
+            # fault site: a queue-full storm (exc=queue_full) or an
+            # admission stall (a sleep here holds the batcher lock —
+            # deliberately: that IS a stalled admission path) — AFTER
+            # the closed check, so injected faults never mask
+            # ServerClosedError for clients of a closed server
+            inject("serving.admit")
             if len(self._q) >= self.max_queue:
                 self.metrics.incr("serving.rejected_queue_full")
                 # Capacity frees one batch at a time: full-queue drain time

@@ -27,6 +27,21 @@ class QueueFullError(ServingError):
         self.retry_after_s = float(retry_after_s)
 
 
+class ServiceUnavailableError(ServingError):
+    """Admission shed because the engine's dispatch circuit breaker is
+    OPEN (the device has been failing every dispatch): rather than
+    admitting requests that would queue, dispatch into a dead device,
+    and time out one batch at a time, the server fails them at submit
+    with ``retry_after_s`` = the breaker's remaining cool-down.  Same
+    retry-later contract as :class:`QueueFullError`, different cause —
+    the queue has room; the device does not.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline expired while it waited in the queue; it was
     shed before dispatch (no device work was spent on it)."""

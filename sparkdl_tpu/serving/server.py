@@ -25,16 +25,20 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir
+from sparkdl_tpu.parallel.engine import CircuitOpenError
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
 from sparkdl_tpu.serving.errors import (DispatchTimeoutError,
-                                        ServerClosedError)
+                                        ServerClosedError,
+                                        ServiceUnavailableError)
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 from sparkdl_tpu.utils.retry import NON_RETRYABLE, with_retries
@@ -185,6 +189,15 @@ class Server:
       * ``host_preprocess`` — optional per-request host-side fn applied
         in ``submit`` on the CALLER's thread (e.g. image resize), so the
         dispatcher never blocks on host prep.
+      * ``dispatch_retries`` / ``breaker_threshold`` /
+        ``breaker_cooldown_s`` — the engines' failure-domain knobs
+        (ISSUE 4): engine-level transient-dispatch retry budget
+        (jittered, capped backoff) and the consecutive-device-error
+        circuit breaker.  While a breaker is OPEN, :meth:`submit` sheds
+        with ``ServiceUnavailableError`` + ``retry_after_s`` instead of
+        letting every request queue, dispatch into a dead device, and
+        time out; :meth:`health` reports live/ready/degraded with the
+        per-bucket breaker state and last error.
     """
 
     def __init__(self, model, variables: Any = None, *,
@@ -202,6 +215,9 @@ class Server:
                  compute_dtype: Optional[Any] = None,
                  output_host_dtype: Optional[Any] = None,
                  host_preprocess: Optional[Callable[[Any], Any]] = None,
+                 dispatch_retries: int = 0,
+                 breaker_threshold: int = 8,
+                 breaker_cooldown_s: float = 30.0,
                  metrics: Optional[Metrics] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
@@ -229,6 +245,21 @@ class Server:
         self._compute_dtype = compute_dtype
         self._output_host_dtype = output_host_dtype
         self._host_preprocess = host_preprocess
+        self._dispatch_retries = max(0, int(dispatch_retries))
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        # Health state machine (ISSUE 4): "ready" <-> "degraded" driven
+        # by dispatch/batch outcomes (every failed ATTEMPT notes
+        # degraded — even one an engine retry later absorbs — and the
+        # next success notes ready), with a bounded transition history
+        # so tests/operators can see degraded->ready recoveries that a
+        # point-in-time poll would race past.
+        self._health_lock = threading.Lock()
+        self._health_state = "ready"
+        self._health_transitions: deque = deque(
+            [{"state": "ready", "t_monotonic": round(time.monotonic(), 3)}],
+            maxlen=64)
+        self._last_error: Optional[Dict[str, Any]] = None
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
         self._engine_lock = threading.Lock()
@@ -276,6 +307,10 @@ class Server:
                     compute_dtype=(None if first is not None
                                    else self._compute_dtype),
                     output_host_dtype=self._output_host_dtype,
+                    dispatch_retries=self._dispatch_retries,
+                    breaker_threshold=self._breaker_threshold,
+                    breaker_cooldown_s=self._breaker_cooldown_s,
+                    on_dispatch_error=self._note_failure,
                     metrics=self.metrics)
                 self._engines[bucket] = eng
             return eng
@@ -296,17 +331,109 @@ class Server:
             eng(stacked)
             self._warm.add(b)
 
+    # -- health / failure domain -------------------------------------------
+    def _note_failure(self, exc: BaseException) -> None:
+        """Record a failed dispatch attempt / batch: state -> degraded.
+        Wired as every engine's ``on_dispatch_error`` hook, so faults an
+        engine-level retry absorbs still leave a health trace."""
+        with self._health_lock:
+            self._last_error = {
+                "type": type(exc).__name__,
+                "error": str(exc)[:300],
+                "t_monotonic": round(time.monotonic(), 3),
+            }
+            if self._health_state != "degraded":
+                self._health_state = "degraded"
+                self._health_transitions.append(
+                    {"state": "degraded",
+                     "t_monotonic": round(time.monotonic(), 3)})
+
+    def _note_success(self) -> None:
+        with self._health_lock:
+            if self._health_state != "ready":
+                self._health_state = "ready"
+                self._health_transitions.append(
+                    {"state": "ready",
+                     "t_monotonic": round(time.monotonic(), 3)})
+
+    def _breaker_states(self) -> Dict[int, Dict[str, Any]]:
+        with self._engine_lock:
+            engines = dict(self._engines)
+        return {b: eng.breaker_state() for b, eng in sorted(engines.items())}
+
+    def _breaker_retry_after(self) -> Optional[float]:
+        """Max remaining cool-down over OPEN bucket breakers, or None
+        when none is open (the per-submit fast path: one cheap query per
+        engine, no state snapshots).  Half-open breakers admit traffic —
+        the trial dispatch that can close them has to come from
+        somewhere."""
+        with self._engine_lock:
+            engines = list(self._engines.values())
+        worst = None
+        for eng in engines:
+            remaining = eng.breaker.open_remaining_s()
+            if remaining is not None:
+                worst = max(worst or 0.0, remaining)
+        return worst
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness/readiness snapshot (JSON-serializable; also embedded
+        in :meth:`varz`):
+
+        * ``live`` — the serving loop exists (False once closed);
+        * ``state`` — ``ready`` (serving normally), ``degraded``
+          (breaker open/half-open, or a dispatch/batch failure with no
+          success since), or ``closed``;
+        * ``last_error`` — most recent failure (type/message/monotonic
+          ts), surviving recovery for post-mortems;
+        * ``breaker`` — per-bucket engine circuit-breaker state;
+        * ``transitions`` — bounded ready/degraded history, so a
+          degraded->ready recovery is observable after the fact.
+        """
+        breakers = self._breaker_states()
+        with self._health_lock:
+            state = self._health_state
+            last_error = dict(self._last_error) if self._last_error else None
+            transitions = list(self._health_transitions)
+        if any(st["state"] in ("open", "half_open")
+               for st in breakers.values()):
+            state = "degraded"
+        if self._closed:
+            state = "closed"
+        return {
+            "live": not self._closed,
+            "state": state,
+            "last_error": last_error,
+            "breaker": breakers,
+            "transitions": transitions,
+        }
+
     # -- request path ------------------------------------------------------
     def submit(self, example: Any,
                timeout_ms: Optional[float] = None) -> Future:
         """Admit one example; returns its ``concurrent.futures.Future``.
 
-        Raises ``ServerClosedError`` after close and ``QueueFullError``
-        (with ``retry_after_s``) under backpressure.  ``timeout_ms``
-        overrides the server's ``default_timeout_ms`` deadline.
+        Raises ``ServerClosedError`` after close, ``QueueFullError``
+        (with ``retry_after_s``) under backpressure, and
+        ``ServiceUnavailableError`` (with ``retry_after_s``) while the
+        dispatch circuit breaker is open — the device is failing every
+        dispatch, so admitting more work would only convert each request
+        into a slow timeout.  ``timeout_ms`` overrides the server's
+        ``default_timeout_ms`` deadline.
         """
         if self._closed:
             raise ServerClosedError("server is closed")
+        retry_after = self._breaker_retry_after()
+        if retry_after is not None:
+            # count the request too: shed-rate consumers compute
+            # rejected_*/requests, and queue-full rejects (raised after
+            # the serving.requests incr below) are in the denominator —
+            # breaker sheds must be as well or the ratio breaks 1.0
+            self.metrics.incr("serving.requests")
+            self.metrics.incr("serving.rejected_breaker_open")
+            raise ServiceUnavailableError(
+                f"dispatch circuit breaker open (device failing); "
+                f"retry in {retry_after:.2f}s", retry_after_s=retry_after)
         if self._host_preprocess is not None:
             example = self._host_preprocess(example)
         import jax
@@ -383,6 +510,7 @@ class Server:
             self._execute(requests, finish)
         except BaseException as e:  # noqa: BLE001 — isolate to this batch
             self.metrics.incr("serving.batch_failures")
+            self._note_failure(e)
             _settle_error(requests, e)
             logger.warning("serving batch of %d failed: %s: %s",
                            len(requests), type(e).__name__, e)
@@ -396,8 +524,12 @@ class Server:
         window, so configuring retries never silently nullifies them) and
         covers ONLY the engine call — compile time is excluded by the
         untimed warm call in ``_execute``, and the host-side demux runs
-        after the timer is disarmed."""
+        after the timer is disarmed.  The ``serving.model`` fault site
+        sits INSIDE the watchdog window (a ``sleep`` rule is a wedged
+        model the watchdog must catch; an ``error`` rule is a per-batch
+        model failure)."""
         if self._dispatch_timeout_s is None:
+            inject("serving.model")
             return eng(stacked)
         attempt_done = threading.Event()
 
@@ -418,6 +550,7 @@ class Server:
         timer.daemon = True
         timer.start()
         try:
+            inject("serving.model")
             return eng(stacked)
         finally:
             attempt_done.set()
@@ -457,12 +590,19 @@ class Server:
         # engine's own spans (engine.call -> engine.dispatch) nest under
         # serving.request -> serving.microbatch
         with tracer.use(batch_span):
+            # CircuitOpenError is exempt from the batch retry budget for
+            # the same reason the engine's own _run_dispatch exempts it:
+            # an open breaker fails fast BY DESIGN, and re-attempting it
+            # max_retries times with backoff would turn every shed batch
+            # into seconds of dead sleep against a device known to be
+            # failing
             out = with_retries(
                 lambda: self._guarded_call(eng, stacked, requests, finish),
                 max_retries=self._max_retries,
-                non_retryable=NON_RETRYABLE,
+                non_retryable=NON_RETRYABLE + (CircuitOpenError,),
                 backoff_seconds=self._retry_backoff_s)
         batch_s = time.monotonic() - t0
+        self._note_success()  # a served batch flips health back to ready
         self._batcher.batch_seconds_hint = batch_s
         self.metrics.incr("serving.batches")
         self.metrics.record_time("serving.batch_latency", batch_s)
@@ -548,6 +688,7 @@ class Server:
                 "queue_depth": self.queue_depth(),
                 "inflight_batches": self._inflight,
             },
+            "health": self.health(),
             "counters": {k: v for k, v in snap["counters"].items()
                          if k.startswith("serving.")},
             "latency_ms": {

@@ -1,0 +1,84 @@
+"""Crash-safe JSONL appender for driver artifacts.
+
+The contract (ISSUE 4): a SIGKILL at ANY instant must leave a valid
+JSONL file containing every record written so far — atexit hooks never
+run under SIGKILL, so the only mechanism that survives one is flushing
+each record as it happens.  Each record is a single ``os.write`` of
+``line + "\\n"`` (a kill between records can never tear a line) followed
+by an ``fsync`` (the kernel has acked it to disk before the writer moves
+on).
+
+Failure policy: ``OSError`` (read-only checkout, full disk) DISABLES the
+writer instead of failing the run — the artifact is a rider on the real
+work (bench numbers, dryrun stages), never a reason to lose it.  Check
+:attr:`disabled` when the artifact is load-bearing.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class CrashSafeJsonlWriter:
+    """Append-only fsync'd line writer; see module docstring."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd: Optional[int] = None
+        self.disabled = False
+
+    def _open(self, truncate: bool) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+        if truncate:
+            flags |= os.O_TRUNC
+        self._fd = os.open(self.path, flags, 0o644)
+
+    def reset(self) -> None:
+        """Truncate and start fresh (one run owns one artifact);
+        re-enables a writer a previous error disabled."""
+        self.close()
+        self.disabled = False
+        try:
+            self._open(truncate=True)
+        except OSError:
+            self.disabled = True
+
+    def write_line(self, line: str) -> bool:
+        """Append one already-serialized JSON line; True iff it reached
+        the disk (False once disabled)."""
+        if self.disabled:
+            return False
+        pos = None
+        try:
+            if self._fd is None:
+                self._open(truncate=False)
+            pos = os.lseek(self._fd, 0, os.SEEK_END)
+            data = (line + "\n").encode()
+            while data:  # a short write (disk filling) must not be
+                n = os.write(self._fd, data)  # silently reported as done
+                data = data[n:]
+            os.fsync(self._fd)
+            return True
+        except OSError:
+            # roll back a torn partial record before disabling — the
+            # whole point of the writer is that every line on disk
+            # parses, including the last one
+            if pos is not None:
+                try:
+                    os.ftruncate(self._fd, pos)
+                except OSError:
+                    pass
+            self.disabled = True
+            return False
+
+    def close(self) -> None:
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None

@@ -11,6 +11,7 @@ unit-of-reexecution economics as a retried Spark task.
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Any, Callable, Optional, Sequence, Tuple, Type
 
@@ -27,11 +28,32 @@ NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
     FloatingPointError, ValueError, TypeError)
 
 
+def backoff_delay(attempt: int, backoff_seconds: float,
+                  max_backoff_seconds: Optional[float] = None,
+                  jitter: float = 0.0,
+                  rng: Optional[random.Random] = None) -> float:
+    """The sleep before re-execution ``attempt`` (0-based): exponential
+    ``backoff_seconds * 2**attempt``, de-synchronized by ``jitter``
+    (each delay is scaled by a uniform draw from ``[1 - jitter, 1]`` so
+    a fleet of retriers never thunders in lockstep), then HARD-capped at
+    ``max_backoff_seconds`` — the cap applies after jitter, so the bound
+    holds no matter the draw (pinned by the unit test)."""
+    delay = backoff_seconds * (2 ** attempt)
+    if jitter:
+        j = min(1.0, max(0.0, float(jitter)))
+        delay *= 1.0 - j * (rng or random).random()
+    if max_backoff_seconds is not None:
+        delay = min(delay, max_backoff_seconds)
+    return max(0.0, delay)
+
+
 def with_retries(fn: Callable[[], Any], *, max_retries: int = 2,
                  retry_on: Tuple[Type[BaseException], ...] = (Exception,),
                  non_retryable: Tuple[Type[BaseException], ...]
                  = NON_RETRYABLE,
                  backoff_seconds: float = 0.0,
+                 max_backoff_seconds: Optional[float] = None,
+                 jitter: float = 0.0,
                  on_retry: Optional[Callable[[int, BaseException], None]]
                  = None) -> Any:
     """Run ``fn()`` with up to ``max_retries`` re-executions.
@@ -41,6 +63,13 @@ def with_retries(fn: Callable[[], Any], *, max_retries: int = 2,
     NON_RETRYABLE; pass ``non_retryable=()`` to retry everything).
     ``on_retry`` (attempt_index, exception) runs before each re-execution
     — the hook for external health checks or device re-initialization.
+
+    Backoff is exponential in ``backoff_seconds``, optionally jittered
+    (``jitter`` in [0, 1]: each delay scaled by a uniform draw from
+    ``[1 - jitter, 1]``) and BOUNDED by ``max_backoff_seconds`` — an
+    unbounded ``backoff * 2**attempt`` turns a large retry budget into
+    minutes of dead air; the cap keeps worst-case added latency
+    ``<= max_retries * max_backoff_seconds`` (see :func:`backoff_delay`).
     """
     attempts = max(0, int(max_retries)) + 1
     last: Optional[BaseException] = None
@@ -58,7 +87,8 @@ def with_retries(fn: Callable[[], Any], *, max_retries: int = 2,
             if on_retry is not None:
                 on_retry(attempt, e)
             if backoff_seconds:
-                time.sleep(backoff_seconds * (2 ** attempt))
+                time.sleep(backoff_delay(attempt, backoff_seconds,
+                                         max_backoff_seconds, jitter))
     assert last is not None
     raise last
 
@@ -68,6 +98,8 @@ def fit_with_retries(estimator, dataset, params=None, *,
                      non_retryable: Tuple[Type[BaseException], ...]
                      = NON_RETRYABLE,
                      backoff_seconds: float = 0.0,
+                     max_backoff_seconds: Optional[float] = None,
+                     jitter: float = 0.0,
                      on_retry: Optional[Callable] = None):
     """``estimator.fit(dataset, params)`` with retry orchestration.
 
@@ -82,4 +114,6 @@ def fit_with_retries(estimator, dataset, params=None, *,
                         max_retries=max_retries,
                         non_retryable=non_retryable,
                         backoff_seconds=backoff_seconds,
+                        max_backoff_seconds=max_backoff_seconds,
+                        jitter=jitter,
                         on_retry=on_retry)

@@ -16,11 +16,18 @@ import bench
 
 
 @pytest.fixture()
-def captured(monkeypatch):
+def captured(monkeypatch, tmp_path):
+    from sparkdl_tpu.utils.jsonl import CrashSafeJsonlWriter
+
     lines = []
     monkeypatch.setattr(bench, "_print_line",
                         lambda s: lines.append(json.loads(s)))
     monkeypatch.setattr(bench, "_LINES", {})
+    # in-process main() calls reset() on the crash-safe artifact rider:
+    # point it at a scratch path so contract tests never truncate the
+    # repo's real artifacts/bench_lines.jsonl forensics record
+    monkeypatch.setattr(bench, "_ARTIFACT",
+                        CrashSafeJsonlWriter(str(tmp_path / "lines.jsonl")))
     return lines
 
 

@@ -229,3 +229,32 @@ def test_prefetch_iter_producer_stops_when_consumer_abandons():
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
     assert len(produced) < 100
+
+
+def test_prefetch_iter_producer_stops_when_consumer_garbage_collected():
+    """The close() above is the polite path; a consumer that simply
+    DROPS the iterator (function return, exception unwound past it) must
+    release the producer too — CPython finalizes the generator on GC,
+    its ``finally`` sets the stop flag, and the producer's bounded-put
+    loop observes it instead of spinning on the full queue forever."""
+    import gc
+    import threading
+    import time
+
+    from sparkdl_tpu.utils.prefetch import prefetch_iter
+
+    def gen():
+        for i in range(100):
+            yield i
+
+    it = prefetch_iter(gen(), depth=1)
+    assert next(it) == 0
+    del it          # consumer walks away without close()
+    gc.collect()    # finalize the generator deterministically
+    deadline = time.monotonic() + 5.0
+    while (any(t.name == "sparkdl-prefetch" for t in threading.enumerate())
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name == "sparkdl-prefetch"]
+    assert not leaked, f"producer thread leaked after consumer GC: {leaked}"
