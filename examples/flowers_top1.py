@@ -70,7 +70,9 @@ def main(argv=None):
     from sparkdl_tpu.parallel.engine import InferenceEngine
     from sparkdl_tpu.utils.prefetch import prefetch_iter
 
-    t0 = time.time()
+    # perf_counter, not time.time(): "seconds" is an elapsed-time
+    # measurement and wall clock can step under NTP slew (SDL006)
+    t0 = time.perf_counter()
     files, labels, classes = gather_files(args.root, args.max_per_class)
     spec = get_model_spec(args.model)
     h, w = spec.input_size
@@ -130,7 +132,7 @@ def main(argv=None):
         "n_train": int(len(tr)), "n_test": int(len(te)),
         "classes": len(classes), "model": args.model,
         "weights_source": weights_source,
-        "seconds": round(time.time() - t0, 1),
+        "seconds": round(time.perf_counter() - t0, 1),
     }))
 
 

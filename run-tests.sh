@@ -42,6 +42,21 @@ if [[ ! -f tests/test_faults.py ]]; then
        "would ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_analysis.py ]]; then
+  echo "FATAL: tests/test_analysis.py missing — the graftlint rules and" \
+       "lock-order checker would ship untested" >&2
+  exit 1
+fi
+
+# graftlint stage (ISSUE 5): the repo's own invariants (joined threads,
+# lockset discipline, registered fault sites, paired spans, monotonic
+# timing — rule table in README "Static analysis") checked statically
+# over the whole stack.  Must exit 0 with every allow-pragma carrying a
+# reason; stdlib-ast only, so the 15 s wall guard is generous (~3 s in
+# practice, no jax init).
+echo "== graftlint static analysis =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu tools bench.py
+
 python -m pytest tests/ -q --durations=10 "$@"
 
 # Fault-suite stage (ISSUE 4 satellite): re-run the chaos suite with
@@ -53,8 +68,13 @@ python -m pytest tests/ -q --durations=10 "$@"
 echo "== fault-injection suite (SPARKDL_FAULTS active) =="
 # -k: skip the SIGKILL bench-subprocess test on this second pass — it
 # sets its own SPARKDL_FAULTS in the child, so re-running it here adds
-# minutes of wall time and zero env-gate coverage
+# minutes of wall time and zero env-gate coverage.
+# SPARKDL_LOCKCHECK=1 (ISSUE 5): the chaos pass doubles as the lock-
+# order probe — every stack lock becomes an analysis.lockcheck wrapper
+# and the injected schedules (stalls, crashes, queue storms) drive the
+# acquisition-order graph; a cycle fails the suite loudly.
 SPARKDL_FAULTS="seed=1;engine.dispatch:sleep:ms=1,times=3" \
+  SPARKDL_LOCKCHECK=1 \
   python -m pytest tests/test_faults.py -q -k "not sigkill"
 
 # Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device

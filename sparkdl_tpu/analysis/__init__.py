@@ -1,0 +1,118 @@
+"""sparkdl_tpu.analysis — graftlint: project-native static analysis +
+runtime concurrency checking.
+
+PRs 1–4 grew a genuinely concurrent scoring stack (batcher/dispatcher/
+worker/pipeline threads, ~19 lock sites, named fault sites, paired
+spans); this package turns those invariants from tribal memory into
+machine-checked rules, run by ``run-tests.sh`` on every invocation and
+by ``tools/graftlint.py`` standalone:
+
+====== ==================================================================
+code   invariant
+====== ==================================================================
+SDL000 every allow pragma carries a ``reason=`` (meta-rule)
+SDL001 started threads are daemonized or joined (PR 4's wedged-queue
+       lesson)
+SDL002 an attribute ever written under ``with self._lock:`` is never
+       written without it (Eraser-style lockset, per class)
+SDL003 broad/bare ``except`` re-raises, logs via ``utils.logging``, or
+       carries an allow pragma
+SDL004 fault-site strings exist in ``faults/sites.py`` (no typo'd
+       chaos sites)
+SDL005 metric/span names match ``dotted.lowercase``; opened spans are
+       closable on every path
+SDL006 ``time.time()`` never feeds a latency subtraction
+       (``perf_counter``/``monotonic`` only)
+====== ==================================================================
+
+Suppress with ``# graftlint: allow=SDLxxx reason=<why>`` on the
+offending line or the line above.  The runtime half —
+:mod:`~sparkdl_tpu.analysis.lockcheck`, gated by ``SPARKDL_LOCKCHECK=1``
+— wraps the stack's locks and fails on acquisition-order cycles under
+the chaos suite's injected schedules.
+
+Everything is stdlib-only and nothing here imports the code under
+analysis, so ``tools/graftlint.py`` runs in milliseconds with no jax
+initialization.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+from sparkdl_tpu.analysis.core import (Finding, LintContext, Module,
+                                       collect_files, load_module,
+                                       run_rules)
+from sparkdl_tpu.analysis.rules_hygiene import rule_sdl003, rule_sdl006
+from sparkdl_tpu.analysis.rules_obs import (rule_sdl005_names,
+                                            rule_sdl005_pairing)
+from sparkdl_tpu.analysis.rules_sites import (load_site_registry,
+                                              load_site_registry_file,
+                                              rule_sdl004)
+from sparkdl_tpu.analysis.rules_threads import rule_sdl001, rule_sdl002
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "ALL_RULES",
+    "RULE_HELP",
+    "lint_source",
+    "lint_paths",
+    "load_site_registry",
+    "load_site_registry_file",
+]
+
+ALL_RULES = (
+    rule_sdl001,
+    rule_sdl002,
+    rule_sdl003,
+    rule_sdl004,
+    rule_sdl005_names,
+    rule_sdl005_pairing,
+    rule_sdl006,
+)
+
+RULE_HELP = {
+    "SDL000": "allow pragmas must carry reason=<why>",
+    "SDL001": "started threads must be daemonized or joined",
+    "SDL002": "lock-guarded attributes are never written lock-free",
+    "SDL003": "broad except must re-raise, log, or carry a pragma",
+    "SDL004": "fault-site strings must exist in faults/sites.py",
+    "SDL005": "metric/span names dotted-lowercase; spans always closed",
+    "SDL006": "time.time() never feeds a latency subtraction",
+}
+
+
+def lint_source(source: str, path: str = "<string>",
+                sites: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one in-memory snippet (the test-fixture entry point).
+    ``sites`` is the fault-site registry SDL004 checks against; None
+    means "no registry found", which SDL004 reports on any site use."""
+    try:
+        module = load_module(source, path)
+    except SyntaxError as e:
+        return [Finding("SDL000", path, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    return run_rules(module, ALL_RULES, LintContext(sites=sites))
+
+
+def lint_paths(targets: Iterable[str],
+               sites: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint files/directories.  The fault-site registry is auto-located
+    under the targets unless passed explicitly."""
+    targets = list(targets)
+    if sites is None:
+        sites = load_site_registry(targets)
+    ctx = LintContext(sites=sites)
+    findings: List[Finding] = []
+    for path in collect_files(targets):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            module = load_module(source, path)
+        except SyntaxError as e:
+            findings.append(Finding("SDL000", path, e.lineno or 1,
+                                    f"syntax error: {e.msg}"))
+            continue
+        findings.extend(run_rules(module, ALL_RULES, ctx))
+    return findings

@@ -1,0 +1,105 @@
+"""SDL004 — every fault-site string must exist in the canonical registry.
+
+The chaos layer's whole value is that a spec'd site FIRES; a typo'd
+site in an ``inject("...")``/``has_rules("...")`` call would silently
+never fire and turn a chaos run vacuous (spec-side typos already fail
+at parse time — this closes the code-side half).  The registry is the
+``SITE_HELP`` table in ``sparkdl_tpu/faults/sites.py``, read HERE with
+``ast`` — the linter never imports the package under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from sparkdl_tpu.analysis.core import Finding, LintContext, Module
+
+_SITE_CALLS = {"inject", "has_rules"}
+
+
+def load_site_registry_file(path: str) -> Optional[Set[str]]:
+    """Parse ONE registry file (``--sites-file``): the keys of its
+    ``SITE_HELP`` dict literal, falling back to a ``SITES`` tuple
+    literal.  None when the file holds neither."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "SITE_HELP" in names and isinstance(node.value, ast.Dict):
+            keys = {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if keys:
+                return keys
+        if "SITES" in names and isinstance(node.value, ast.Tuple):
+            keys = {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+            if keys:
+                return keys
+    return None
+
+
+def load_site_registry(targets: Iterable[str]) -> Optional[Set[str]]:
+    """Auto-locate ``faults/sites.py`` under the DIRECTORY targets and
+    extract its site set (plain-file targets contribute only if they
+    are themselves a ``sites.py`` — linting ``bench.py`` must not walk
+    the whole checkout).  None when no registry file is found; pass an
+    explicit file through :func:`load_site_registry_file` instead."""
+    candidates: List[str] = []
+    for t in targets:
+        if os.path.isfile(t):
+            if os.path.basename(t) == "sites.py":
+                candidates.append(t)
+            continue
+        direct = os.path.join(t, "faults", "sites.py")
+        if os.path.isfile(direct):
+            candidates.append(direct)
+            continue
+        for dirpath, dirnames, filenames in os.walk(t):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            if "sites.py" in filenames and \
+                    os.path.basename(dirpath) == "faults":
+                candidates.append(os.path.join(dirpath, "sites.py"))
+    for path in candidates:
+        sites = load_site_registry_file(path)
+        if sites:
+            return sites
+    return None
+
+
+def rule_sdl004(module: Module, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = ctx.sites
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name not in _SITE_CALLS or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        if sites is None:
+            findings.append(Finding(
+                "SDL004", module.path, node.lineno,
+                f"fault site {first.value!r} used but no canonical "
+                f"registry (faults/sites.py SITE_HELP) was found under "
+                f"the lint targets — site strings cannot be verified"))
+            continue
+        if first.value not in sites:
+            known = ", ".join(sorted(sites))
+            findings.append(Finding(
+                "SDL004", module.path, node.lineno,
+                f"unknown fault site {first.value!r} — a typo'd site "
+                f"never fires and makes chaos runs vacuous; register it "
+                f"in faults/sites.py or fix the name (known: {known})"))
+    return findings

@@ -39,6 +39,7 @@ from sparkdl_tpu.faults.errors import (InjectedDeadDeviceError,
 from sparkdl_tpu.faults.plan import (FaultPlan, active, clear, configure,
                                      configure_from_env, current_spec,
                                      get_plan, has_rules, inject)
+from sparkdl_tpu.faults.sites import SITE_HELP, validate_site
 from sparkdl_tpu.faults.spec import (ACTIONS, SITES, FaultRule,
                                      faults_from_env, format_spec,
                                      parse_spec)
@@ -47,6 +48,8 @@ __all__ = [
     "FaultPlan",
     "FaultRule",
     "SITES",
+    "SITE_HELP",
+    "validate_site",
     "ACTIONS",
     "inject",
     "has_rules",

@@ -23,14 +23,15 @@ path — the only one production traffic sees — takes no lock at all.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
+from sparkdl_tpu.analysis import lockcheck
 from sparkdl_tpu.faults.errors import (InjectedDeadDeviceError,
                                        InjectedDecodeError, InjectedFault,
                                        InjectedFatalError,
                                        InjectedTransientError)
+from sparkdl_tpu.faults.sites import validate_site
 from sparkdl_tpu.faults.spec import (FaultRule, faults_from_env, format_spec,
                                      parse_spec)
 
@@ -80,8 +81,12 @@ class FaultPlan:
                     self.seed = embedded_seed
                 self.rules.extend(parsed)
             else:
+                # re-validate even pre-built FaultRule objects: a rule
+                # whose site was mutated after construction must fail
+                # HERE, at plan build, not silently never fire
+                validate_site(r.site)
                 self.rules.append(r)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("faults.plan")
         self._site_calls: Dict[str, int] = {}
         self._fired: Dict[int, int] = {}       # rule index -> firings
         self._sticky_dead: Dict[str, str] = {}  # site -> clause that died
@@ -192,7 +197,7 @@ class FaultPlan:
 # -- module singleton (the SPARKDL_TRACE pattern) --------------------------
 _UNSET = object()   # before the first inject() consults SPARKDL_FAULTS
 _PLAN: Any = _UNSET
-_PLAN_LOCK = threading.Lock()
+_PLAN_LOCK = lockcheck.named_lock("faults.configure")
 
 
 def inject(site: str, **ctx: Any) -> None:

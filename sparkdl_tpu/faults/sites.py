@@ -1,0 +1,50 @@
+"""Canonical fault-site registry — THE one list of injection points.
+
+Every ``faults.inject("<site>")``/``has_rules("<site>")`` call in the
+stack and every site named in a ``SPARKDL_FAULTS`` spec must come from
+this table.  Both halves are enforced: spec parsing and
+``FaultPlan``/``FaultRule`` construction reject unknown sites at
+CONSTRUCTION time (:func:`validate_site`), and graftlint rule SDL004
+statically checks the code-side strings against this file (read with
+``ast``, never imported) — so a typo'd site can neither be spec'd nor
+silently compiled into a hot path where it would never fire.
+
+Keep the table sorted by layer; the value is the one-line operator
+description ``tools/graftlint.py --list-rules``-style tooling and the
+README's failure-model table can render.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+#: site -> operator-facing description of what fires there.
+SITE_HELP = {
+    "engine.dispatch": "InferenceEngine H2D + program launch attempt",
+    "engine.gather": ("InferenceEngine result force (D2H) — where a "
+                      "dying device surfaces under async dispatch"),
+    "pipeline.prepare": "PipelinedRunner host-prepare stage loop",
+    "pipeline.dispatch": "PipelinedRunner dispatch stage loop",
+    "pipeline.gather": "PipelinedRunner gather stage loop",
+    "serving.admit": "DynamicBatcher.submit admission",
+    "serving.model": "Server model-call attempt (watchdog-timed)",
+    "probe.device": "__graft_entry__ device-count relay probe",
+    "bench.relay_probe": "bench.py relay profile probe",
+    "io.decode": "host image decode, per row",
+}
+
+#: Registered injection sites, in layer order (the tuple every public
+#: surface has exported since PR 4 — now derived from SITE_HELP so the
+#: registry cannot drift from its documentation).
+SITES: Tuple[str, ...] = tuple(SITE_HELP)
+
+
+def validate_site(site: str) -> str:
+    """Return ``site`` if registered, else raise ``ValueError`` naming
+    the known sites — the construction-time gate ``FaultRule``,
+    ``FaultPlan``, and spec parsing all share."""
+    if site not in SITE_HELP:
+        raise ValueError(
+            f"unknown fault site {site!r}; known sites: "
+            f"{', '.join(SITES)}")
+    return site

@@ -36,22 +36,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-#: Registered injection sites — the named points the scoring stack
-#: threads through its hot paths.  Parse rejects anything else (a typo'd
-#: site that never fires would make a chaos run silently vacuous).
-SITES = (
-    "engine.dispatch",      # InferenceEngine H2D + program launch attempt
-    "engine.gather",        # InferenceEngine result force (D2H) — where a
-                            # dying device surfaces under async dispatch
-    "pipeline.prepare",     # PipelinedRunner host-prepare stage loop
-    "pipeline.dispatch",    # PipelinedRunner dispatch stage loop
-    "pipeline.gather",      # PipelinedRunner gather stage loop
-    "serving.admit",        # DynamicBatcher.submit admission
-    "serving.model",        # Server model-call attempt (watchdog-timed)
-    "probe.device",         # __graft_entry__ device-count relay probe
-    "bench.relay_probe",    # bench.py relay profile probe
-    "io.decode",            # host image decode, per row
-)
+# The canonical injection-point registry lives in
+# sparkdl_tpu/faults/sites.py (one table, read statically by graftlint
+# SDL004); SITES is re-exported here for compatibility with every
+# caller that imported it from the spec module since PR 4.
+from sparkdl_tpu.faults.sites import SITE_HELP, SITES, validate_site
 
 ACTIONS = ("error", "sleep", "dead")
 EXC_KINDS = ("transient", "fatal", "dead", "decode", "queue_full")
@@ -71,10 +60,7 @@ class FaultRule:
     params: Dict[str, float] = field(default_factory=dict)
 
     def __post_init__(self):
-        if self.site not in SITES:
-            raise ValueError(
-                f"unknown fault site {self.site!r}; known sites: "
-                f"{', '.join(SITES)}")
+        validate_site(self.site)
         if self.action not in ACTIONS:
             raise ValueError(
                 f"unknown fault action {self.action!r} (site {self.site}); "

@@ -15,11 +15,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -28,7 +28,7 @@ _SRC = os.path.join(os.path.dirname(__file__), "sparkdl_native.cpp")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _LIB_PATH = os.path.join(_LIB_DIR, "libsparkdl_native.so")
 
-_lock = threading.Lock()
+_lock = named_lock("native.load")
 _lib = None
 _load_attempted = False
 

@@ -392,9 +392,11 @@ def sepconv_reference(x, dwk, pw, scale, shift, pre_relu: bool,
 
 
 def _on_tpu() -> bool:
+    # capability probe: jax raises RuntimeError when no backend can
+    # initialize — any other exception type is a real bug and surfaces
     try:
         return jax.default_backend() in ("tpu", "axon")
-    except Exception:
+    except RuntimeError:
         return False
 
 

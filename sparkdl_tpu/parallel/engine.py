@@ -25,12 +25,12 @@ Throughput design:
 
 from __future__ import annotations
 
-import threading
 import time as time_lib
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.parallel import mesh as mesh_lib
@@ -75,7 +75,7 @@ class DispatchCircuitBreaker:
     def __init__(self, threshold: int = 8, cooldown_s: float = 30.0):
         self.threshold = int(threshold)
         self.cooldown_s = max(0.0, float(cooldown_s))
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.breaker")
         self._consecutive = 0
         self._open_until = 0.0
         self._open = False

@@ -225,7 +225,8 @@ class PipelinedRunner:
                     if not self._put(prep_q, item, stop, "prepare",
                                      "prep_q"):
                         return
-            except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            # graftlint: allow=SDL003 reason=recorded via fail() and re-raised consumer-side as PipelineStageError
+            except BaseException as e:
                 fail("prepare", idx, e)
 
         def dispatch() -> None:
@@ -251,7 +252,8 @@ class PipelinedRunner:
                                      "dispatch", "inflight_q"):
                         return
                 self._put(disp_q, _DONE, stop, "dispatch", "inflight_q")
-            except BaseException as e:  # noqa: BLE001
+            # graftlint: allow=SDL003 reason=recorded via fail() and re-raised consumer-side as PipelineStageError
+            except BaseException as e:
                 fail("dispatch", idx, e)
 
         def gather() -> None:
@@ -285,7 +287,8 @@ class PipelinedRunner:
                             return
                     m.incr("pipeline.gathers")
                 self._put(out_q, _DONE, stop, "gather", "out_q")
-            except BaseException as e:  # noqa: BLE001
+            # graftlint: allow=SDL003 reason=recorded via fail() and re-raised consumer-side as PipelineStageError
+            except BaseException as e:
                 fail("gather", idx, e)
 
         threads = [

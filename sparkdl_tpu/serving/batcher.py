@@ -14,12 +14,12 @@ dispatch, and demultiplexing.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, List, Optional
 
+from sparkdl_tpu.analysis.lockcheck import named_condition
 from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import (DeadlineExceededError, QueueFullError,
@@ -103,7 +103,7 @@ class DynamicBatcher:
         # retry_after hint before the first batch completes.
         self.batch_seconds_hint = max(self.max_wait_s, 1e-3)
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = named_condition("serving.batcher")
         self._closed = False
         self._drain = True
 

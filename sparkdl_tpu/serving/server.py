@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sparkdl_tpu.analysis.lockcheck import named_condition, named_lock
 from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir
 from sparkdl_tpu.parallel.engine import CircuitOpenError
@@ -123,7 +124,7 @@ class _Once:
 
     def __init__(self, fn: Callable[[], None]):
         self._fn = fn
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.once")
         self._done = False
 
     def __call__(self) -> None:
@@ -254,7 +255,7 @@ class Server:
         # next success notes ready), with a bounded transition history
         # so tests/operators can see degraded->ready recoveries that a
         # point-in-time poll would race past.
-        self._health_lock = threading.Lock()
+        self._health_lock = named_lock("serving.health")
         self._health_state = "ready"
         self._health_transitions: deque = deque(
             [{"state": "ready", "t_monotonic": round(time.monotonic(), 3)}],
@@ -262,7 +263,7 @@ class Server:
         self._last_error: Optional[Dict[str, Any]] = None
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
-        self._engine_lock = threading.Lock()
+        self._engine_lock = named_lock("serving.engines")
         self._batcher = DynamicBatcher(
             max_batch_size=self.max_batch_size, max_wait_ms=max_wait_ms,
             max_queue=max_queue, metrics=self.metrics)
@@ -272,7 +273,7 @@ class Server:
         self._closed = False
         self._abandon = threading.Event()
         self._inflight = 0
-        self._inflight_cond = threading.Condition()
+        self._inflight_cond = named_condition("serving.inflight")
         self._inflight_sem = threading.Semaphore(
             max(1, int(max_inflight_batches)))
         self._dispatcher = threading.Thread(

@@ -8,15 +8,16 @@ cannot race the eviction loop.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
 
 
 class BoundedCache:
     def __init__(self, cap: int):
         self.cap = int(cap)
         self._data: Dict[Any, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.cache.bounded")
 
     def get(self, key) -> Optional[Any]:
         return self._data.get(key)
@@ -62,7 +63,7 @@ class ByteBoundedLRU:
             lambda v: getattr(v, "nbytes", None) or sys.getsizeof(v))
         self._data: Dict[Any, Any] = {}
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.cache.lru")
 
     def get(self, key, default=None):
         with self._lock:

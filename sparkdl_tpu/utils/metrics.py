@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from sparkdl_tpu.analysis.lockcheck import named_lock
+
 
 @dataclass
 class Metrics:
@@ -41,8 +43,11 @@ class Metrics:
     # a server recording per-request latency indefinitely holds O(cap)
     # floats per series, and percentiles describe the recent window.
     max_samples: int = 16384
-    _lock: threading.Lock = field(default_factory=threading.Lock,
-                                  init=False, repr=False, compare=False)
+    # named_lock: a plain threading.Lock unless SPARKDL_LOCKCHECK=1, in
+    # which case acquisitions feed the analysis.lockcheck order graph
+    _lock: threading.Lock = field(
+        default_factory=lambda: named_lock("utils.metrics"),
+        init=False, repr=False, compare=False)
 
     def incr(self, name: str, value: float = 1.0):
         with self._lock:

@@ -61,7 +61,8 @@ def _run_stage(log: StageLog, stage: str, fn) -> bool:
     t0 = time.perf_counter()
     try:
         detail = fn()
-    except BaseException as e:  # noqa: BLE001 — the record IS the report
+    # graftlint: allow=SDL003 reason=the written stage record IS the report; driver greps it for pass/fail
+    except BaseException as e:
         log.write(stage=stage, status="error",
                   seconds=round(time.perf_counter() - t0, 3),
                   error=f"{type(e).__name__}: {str(e)[:300]}")
