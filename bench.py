@@ -82,14 +82,33 @@ V100_BASELINE_IPS = 875.0
 # XLA cost_analysis FLOPs per image (bf16, fused preprocess, this repo's
 # models at their native input sizes) — the scaling basis for per-model
 # V100 denominators; derivation in BASELINE.md "Appendix: per-model
-# denominators".
-ZOO_GFLOP_PER_IMG = {
+# denominators".  Pinned FALLBACK values only: the live numbers come
+# from the committed program lockfile below (graftcheck measures the
+# exact programs this bench runs), and tests/test_graftcheck.py fails
+# when the two disagree beyond tolerance — so a program change that
+# moves real FLOPs cannot silently keep a stale denominator.
+_ZOO_GFLOP_FALLBACK = {
     "InceptionV3": 10.997,  # 299x299
     "ResNet50": 7.522,      # 224x224
     "VGG16": 29.972,        # 224x224
     "VGG19": 37.951,        # 224x224
     "Xception": 16.799,     # 299x299
 }
+
+
+def _zoo_gflop_per_img():
+    """Per-model GF/img: PROGRAMS.lock.json (the audited featurize
+    programs) where present, pinned fallback otherwise.  Restricted to
+    the reference zoo — beyond-reference models keep vs_baseline null
+    even though the lockfile audits them too."""
+    from sparkdl_tpu.analysis.program.lockfile import zoo_gflop_per_img
+
+    locked = zoo_gflop_per_img()
+    return {model: locked.get(model, fallback)
+            for model, fallback in _ZOO_GFLOP_FALLBACK.items()}
+
+
+ZOO_GFLOP_PER_IMG = _zoo_gflop_per_img()
 
 
 def v100_baseline(model):
@@ -102,14 +121,16 @@ def v100_baseline(model):
     g = ZOO_GFLOP_PER_IMG.get(model)
     if g is None:
         return None, None
-    ips = V100_BASELINE_IPS * ZOO_GFLOP_PER_IMG["InceptionV3"] / g
+    g_inc = ZOO_GFLOP_PER_IMG["InceptionV3"]
+    ips = V100_BASELINE_IPS * g_inc / g
     return ips, (
         f"flop-scaled from sourced InceptionV3 875 img/s x "
-        f"(10.997 / {g} GF/img, XLA cost_analysis); conservative for "
-        f"depthwise models (era cuDNN ran them below FLOP parity)"
+        f"({g_inc:.3f} / {g:.3f} GF/img, XLA cost_analysis); "
+        f"conservative for depthwise models (era cuDNN ran them below "
+        f"FLOP parity)"
         if model == "Xception" else
         f"flop-scaled from sourced InceptionV3 875 img/s x "
-        f"(10.997 / {g} GF/img, XLA cost_analysis)")
+        f"({g_inc:.3f} / {g:.3f} GF/img, XLA cost_analysis)")
 
 
 BATCH = int(os.environ.get("SPARKDL_BENCH_BATCH", "128"))
@@ -488,7 +509,9 @@ def measure_scan(fn, variables, h, w, batch, steps, distinct=4,
         return jax.lax.scan(body, jnp.float32(0),
                             jnp.arange(steps, dtype=jnp.int32))[0]
 
-    g = jax.jit(scan_fn, in_shardings=(eng._replicated, sh))
+    # no donation: the same stacked input is re-dispatched (warm + timed)
+    g = jax.jit(scan_fn, in_shardings=(eng._replicated, sh),
+                donate_argnums=())
     float(g(eng.variables, xd))  # warm: compile + one run
     t0 = time.perf_counter()
     float(g(eng.variables, xd))  # one dispatch, one scalar fetch

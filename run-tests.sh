@@ -47,6 +47,12 @@ if [[ ! -f tests/test_analysis.py ]]; then
        "lock-order checker would ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_graftcheck.py ]]; then
+  echo "FATAL: tests/test_graftcheck.py missing — the program auditor" \
+       "(GC rules, lockfile contract, repo-audits-clean gate) would" \
+       "ship untested" >&2
+  exit 1
+fi
 
 # graftlint stage (ISSUE 5): the repo's own invariants (joined threads,
 # lockset discipline, registered fault sites, paired spans, monotonic
@@ -56,6 +62,18 @@ fi
 # practice, no jax init).
 echo "== graftlint static analysis =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu tools bench.py
+
+# graftcheck program audit (ISSUE 6): every compiled program the stack
+# constructs (full zoo x serving bucket plan, train steps, sepconv
+# kernels) lowered abstractly on CPU and checked against the committed
+# PROGRAMS.lock.json fingerprints (rules GC000-GC005: donation, bf16
+# dtype leaks, retrace keys, pad-waste budget, sharding).  Must exit 0;
+# any drift names the GC rule that moved.  The sweep itself runs in
+# ~35 s chip-free (acceptance budget: under 60 s); the 90 s wall guard
+# covers loaded CI hosts.  Regenerate after a reviewed program change:
+#   python tools/graftcheck.py --write-baseline
+echo "== graftcheck program audit =="
+timeout -k 10 90 python tools/graftcheck.py
 
 python -m pytest tests/ -q --durations=10 "$@"
 

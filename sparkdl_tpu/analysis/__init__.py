@@ -23,6 +23,9 @@ SDL005 metric/span names match ``dotted.lowercase``; opened spans are
        closable on every path
 SDL006 ``time.time()`` never feeds a latency subtraction
        (``perf_counter``/``monotonic`` only)
+SDL007 every ``jax.jit`` call site passes an explicit
+       ``donate_argnums``/``donate_argnames`` (empty = decided "no");
+       the lowered-program half is graftcheck GC001
 ====== ==================================================================
 
 Suppress with ``# graftlint: allow=SDLxxx reason=<why>`` on the
@@ -44,6 +47,7 @@ from sparkdl_tpu.analysis.core import (Finding, LintContext, Module,
                                        collect_files, load_module,
                                        run_rules)
 from sparkdl_tpu.analysis.rules_hygiene import rule_sdl003, rule_sdl006
+from sparkdl_tpu.analysis.rules_jit import rule_sdl007
 from sparkdl_tpu.analysis.rules_obs import (rule_sdl005_names,
                                             rule_sdl005_pairing)
 from sparkdl_tpu.analysis.rules_sites import (load_site_registry,
@@ -70,6 +74,7 @@ ALL_RULES = (
     rule_sdl005_names,
     rule_sdl005_pairing,
     rule_sdl006,
+    rule_sdl007,
 )
 
 RULE_HELP = {
@@ -80,6 +85,7 @@ RULE_HELP = {
     "SDL004": "fault-site strings must exist in faults/sites.py",
     "SDL005": "metric/span names dotted-lowercase; spans always closed",
     "SDL006": "time.time() never feeds a latency subtraction",
+    "SDL007": "every jax.jit site decides donation explicitly",
 }
 
 

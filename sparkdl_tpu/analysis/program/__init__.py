@@ -1,0 +1,72 @@
+"""graftcheck — jaxpr/StableHLO program auditing (ISSUE 6).
+
+graftlint (the sibling rule set, ``sparkdl_tpu.analysis``) checks the
+PYTHON source; this package checks the COMPILED PROGRAMS the stack
+actually ships.  Every program the scoring/training stack constructs —
+the full zoo × the serving bucket plan, the data-parallel train step
+(plain and ``steps_per_execution`` scan), the sepconv Pallas-path jits —
+is lowered ABSTRACTLY on CPU (``jax.eval_shape``/``jit(...).lower()``
+over ``ShapeDtypeStruct`` avals: no device, no weights, no compile) and
+audited against program-level rules:
+
+====== ==================================================================
+code   invariant
+====== ==================================================================
+GC000  committed program fingerprint (StableHLO hash) matches the audit
+GC001  dispatch/train-path jits donate their consumable inputs, and a
+       DECLARED donation actually establishes its input/output aliases
+       (a dtype/layout mismatch silently drops donation) — or the
+       program carries a recorded reason
+GC002  under a declared bf16 compute dtype no ``dot_general``/
+       ``convolution`` runs in f32 (the whole-network upcasts PR 6
+       found and fixed in InceptionV3/EfficientNetB0)
+GC003  the statically enumerated (fn, mesh, donation, shape, dtype)
+       executable cache keys contain no weak types, no duplicates, and
+       no same-shape dtype churn that would recompile the "same"
+       program
+GC004  pad-to-bucket waste stays inside budget: per-bucket
+       ``cost_analysis`` FLOPs split into useful vs pad rows, adjacent
+       buckets within the interior-waste budget
+GC005  every program's params/batch shardings are consistent with the
+       mesh axes (batch divisible by the data axis, shardings present
+       in the lowered text), and no large param is fully replicated
+       while a usable model axis exists
+====== ==================================================================
+
+Findings are serialized into a committed ``PROGRAMS.lock.json``
+(per-program StableHLO hash, FLOPs, bytes accessed, donation map,
+dtype-mix counters, sharding summary), so ANY drift — a dropped
+donation, a dtype regression, a new retrace key, pad growth — fails
+``run-tests.sh``'s graftcheck stage deterministically without a chip.
+``tools/graftcheck.py`` is the CLI; ``--write-baseline`` regenerates
+the lockfile after a reviewed, deliberate program change.
+"""
+
+from __future__ import annotations
+
+from sparkdl_tpu.analysis.program.audit import (GC_RULE_HELP, ProgramSpec,
+                                                audit_inventory,
+                                                audit_program,
+                                                pad_waste_audit,
+                                                retrace_audit)
+from sparkdl_tpu.analysis.program.inventory import stack_programs
+from sparkdl_tpu.analysis.program.lockfile import (DEFAULT_LOCKFILE,
+                                                   diff_records,
+                                                   read_lockfile,
+                                                   write_lockfile,
+                                                   zoo_gflop_per_img)
+
+__all__ = [
+    "GC_RULE_HELP",
+    "ProgramSpec",
+    "audit_program",
+    "audit_inventory",
+    "retrace_audit",
+    "pad_waste_audit",
+    "stack_programs",
+    "DEFAULT_LOCKFILE",
+    "read_lockfile",
+    "write_lockfile",
+    "diff_records",
+    "zoo_gflop_per_img",
+]

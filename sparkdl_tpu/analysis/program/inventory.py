@@ -1,0 +1,337 @@
+"""The stack's program inventory: every jit program the scoring and
+training layers construct, as :class:`~sparkdl_tpu.analysis.program.
+audit.ProgramSpec`s built from the SAME constructors the runtime uses
+(``parallel.engine.build_dispatch_jit``, ``serving.server.bucket_plan``,
+``transformers.named_image.zoo_model_fn``, ``parallel.train.
+make_train_step``, the ``ops.sepconv`` kernel jits) — so the audited
+program set cannot drift from the served one.
+
+Abstract by construction: model variables come from
+``ModelSpec.abstract_variables()`` (``jax.eval_shape`` over ``init`` —
+shape/dtype only), batches are ``ShapeDtypeStruct``s, and nothing is
+ever placed on a device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from sparkdl_tpu.analysis.program.audit import ProgramSpec
+
+#: The donation exemption every zoo dispatch program records (GC001):
+#: proved by the audit itself — jax reports the donated uint8 batch
+#: unusable because no f32/bf16 output can alias it.
+ZOO_DONATE_REASON = (
+    "uint8 image batch cannot alias the float feature output (smaller, "
+    "different dtype); XLA drops the donation, so the engine leaves "
+    "donate_batch off for zoo programs")
+
+SEPCONV_DONATE_REASON = (
+    "chained padded-flat activations; callers reuse the input "
+    "(residual adds), so donation would corrupt the residual source")
+
+#: Canonical kernel audit shapes: Xception middle flow (sepconv), entry
+#: flow block under row tiling, MobileNetV2 inverted-residual tail.
+_KERNEL_SHAPES = {
+    "sepconv": dict(b=8, h=19, w=19, c=728, f=728),
+    "sepconv_tiled": dict(b=8, h=74, w=74, c=256, f=256, th=8),
+    "mbconv": dict(b=8, h=28, w=28, c=192, f=32),
+}
+
+
+def _cast_floating_avals(avals, dtype):
+    """ShapeDtypeStruct twin of the engine's ``_cast_floating``: the
+    audited variables must carry the dtype the engine would actually
+    place on device under a compute-dtype knob."""
+    import jax
+    import jax.numpy as jnp
+
+    def cast(leaf):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return jax.ShapeDtypeStruct(leaf.shape, dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, avals)
+
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    return {str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def zoo_dispatch_specs(max_batch_size: int = 32,
+                       models: Optional[Sequence[str]] = None,
+                       compute_dtype: str = "bfloat16",
+                       mesh=None) -> List[ProgramSpec]:
+    """One spec per (zoo model x serving bucket x cut): the engine
+    program exactly as ``_zoo_engine`` + ``InferenceEngine`` build it
+    (fused preprocess, compute-dtype cast, replicated params, data-axis
+    batch sharding) — the featurizer cut at every compiled shape in the
+    serving bucket plan, the predictor cut (``Server(featurize=False)``,
+    the serving default) at the largest bucket, and the grouped
+    ``batches_per_dispatch`` ``lax.map`` program for one representative
+    model."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import SUPPORTED_MODELS, get_model_spec
+    from sparkdl_tpu.parallel.engine import (effective_device_batch,
+                                             resolve_engine_mesh)
+    from sparkdl_tpu.serving.server import bucket_plan
+
+    mesh = resolve_engine_mesh(mesh)
+    buckets = bucket_plan(max_batch_size, mesh=mesh)
+    names = list(models) if models else list(SUPPORTED_MODELS)
+    axes = _mesh_axes(mesh)
+    cdt = jnp.bfloat16 if compute_dtype == "bfloat16" else None
+    specs: List[ProgramSpec] = []
+    # one abstract-variables eval_shape per model, shared by its buckets
+    # and cuts
+    memo: Dict[str, Any] = {}
+
+    def avals(name: str):
+        mspec = get_model_spec(name)
+        if name not in memo:
+            av = mspec.abstract_variables()
+            if cdt is not None:
+                av = _cast_floating_avals(av, cdt)
+            memo[name] = av
+        return memo[name]
+
+    def build(name: str, bucket: int, featurize: bool, group_k: int = 0):
+        def _build():
+            import jax
+            import numpy as np
+
+            from sparkdl_tpu.parallel.engine import (
+                build_dispatch_jit, build_grouped_dispatch_jit)
+            from sparkdl_tpu.transformers.named_image import zoo_model_fn
+
+            mspec = get_model_spec(name)
+            fn = zoo_model_fn(name, featurize=featurize, compute_dtype=cdt)
+            h, w = mspec.input_size
+            if group_k:
+                jitted = build_grouped_dispatch_jit(
+                    fn, mesh, donate_batch=False,
+                    batches_per_dispatch=group_k)
+                batch = jax.ShapeDtypeStruct((group_k, bucket, h, w, 3),
+                                             np.uint8)
+            else:
+                jitted = build_dispatch_jit(fn, mesh, donate_batch=False)
+                batch = jax.ShapeDtypeStruct((bucket, h, w, 3), np.uint8)
+            return jitted, (avals(name), batch)
+
+        return _build
+
+    base = dict(kind="dispatch", compute_dtype=compute_dtype, donate=(),
+                donate_reason=ZOO_DONATE_REASON, mesh_axes=axes)
+    for name in names:
+        canonical = get_model_spec(name).name  # registry casing
+        for b in buckets:
+            specs.append(ProgramSpec(
+                name=f"zoo/{canonical}/featurize/{compute_dtype}/b{b}",
+                build=build(canonical, b, featurize=True),
+                batch_rows=b,
+                shardings=("replicated", "batch"),
+                group=f"zoo/{canonical}/featurize/{compute_dtype}",
+                model=canonical, bucket=b, **base))
+        # the predictor cut (serving default) at ONE fixed canonical
+        # bucket (b32, mesh-rounded — stable across --max-batch subset
+        # audits); no model/bucket tags: GC004's pad accounting is
+        # cut-independent and already gated by the featurize set above
+        pb = effective_device_batch(32, mesh)
+        specs.append(ProgramSpec(
+            name=f"zoo/{canonical}/predict/{compute_dtype}/b{pb}",
+            build=build(canonical, pb, featurize=False),
+            batch_rows=pb,
+            shardings=("replicated", "batch"),
+            group=f"zoo/{canonical}/predict/{compute_dtype}", **base))
+    # the grouped lax.map dispatch program (SPARKDL_BATCHES_PER_DISPATCH
+    # > 1): the wrapper is model-independent, so ONE representative
+    # (MobileNetV2, the cheapest trace) at a FIXED canonical shape
+    # (b32 x k4, like the train specs) — stable across subset audits,
+    # so narrowed runs still line up with the committed baseline
+    rep = "MobileNetV2"
+    if any(get_model_spec(n).name == rep for n in names):
+        specs.append(ProgramSpec(
+            name=f"zoo/{rep}/featurize/{compute_dtype}/b32xk4",
+            build=build(rep, 32, featurize=True, group_k=4),
+            batch_rows=32 * 4,
+            shardings=("replicated", "stacked_batch"),
+            group=f"zoo/{rep}/featurize/{compute_dtype}/grouped", **base))
+    return specs
+
+
+def train_step_specs(batch_rows: int = 32, feature_dim: int = 2048,
+                     num_classes: int = 10, mesh=None) -> List[ProgramSpec]:
+    """The data-parallel train-step programs the estimator layer
+    compiles (``parallel.train.make_train_step``): the transfer-learning
+    linear head (``estimators.classification``'s fit program) as the
+    plain per-step jit and the ``steps_per_execution`` multi-step scan.
+    Donation is the whole point here (params/opt_state are donated and
+    every leaf must alias), so these are GC001's primary subjects."""
+    from sparkdl_tpu.parallel.engine import resolve_engine_mesh
+
+    mesh = resolve_engine_mesh(mesh)
+    axes = _mesh_axes(mesh)
+
+    def make(kind_multi: bool):
+        def _build():
+            import jax
+            import numpy as np
+            import optax
+
+            from sparkdl_tpu.parallel.train import make_train_step
+
+            def predict_fn(p, xb):
+                return xb @ p["w"] + p["b"]  # the linear-head logits
+
+            opt = optax.adam(1e-3)
+            step = make_train_step(predict_fn,
+                                   "sparse_categorical_crossentropy",
+                                   opt, mesh=mesh, cache=False)
+            params_av = {
+                "w": jax.ShapeDtypeStruct((feature_dim, num_classes),
+                                          np.float32),
+                "b": jax.ShapeDtypeStruct((num_classes,), np.float32),
+            }
+            opt_av = jax.eval_shape(opt.init, params_av)
+            x = jax.ShapeDtypeStruct((batch_rows, feature_dim), np.float32)
+            y = jax.ShapeDtypeStruct((batch_rows,), np.int32)
+            if not kind_multi:
+                return step.step_fn, (params_av, opt_av, x, y)
+            k = 4
+            xs = jax.ShapeDtypeStruct((k, batch_rows, feature_dim),
+                                      np.float32)
+            ys = jax.ShapeDtypeStruct((k, batch_rows), np.int32)
+            return step.multi(k), (params_av, opt_av, xs, ys)
+
+        return _build
+
+    return [
+        ProgramSpec(name=f"train/linear_head/step/b{batch_rows}",
+                    build=make(False), kind="train", donate=(0, 1),
+                    batch_rows=batch_rows, mesh_axes=axes,
+                    shardings=("replicated", "replicated",
+                               "batch", "batch"),
+                    group="train/linear_head/step"),
+        ProgramSpec(name=f"train/linear_head/multi4/b{batch_rows}",
+                    build=make(True), kind="train", donate=(0, 1),
+                    batch_rows=batch_rows, mesh_axes=axes,
+                    shardings=("replicated", "replicated",
+                               "stacked_batch", "stacked_batch"),
+                    group="train/linear_head/multi4"),
+    ]
+
+
+def sepconv_kernel_specs() -> List[ProgramSpec]:
+    """The fused Pallas kernel jits (``ops/sepconv.py``) at their
+    canonical Xception/MobileNetV2 shapes, lowered through the pallas
+    INTERPRETER (``interpret=True``) so the fingerprint is chip-free.
+    No mesh/sharding (kernels shard through the caller's program) and a
+    recorded donation exemption: the flat activations chain."""
+
+    def build_sepconv():
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.ops.sepconv import _fused_sepconv_tpu, flat_width
+
+        s = _KERNEL_SHAPES["sepconv"]
+        lo = (s["h"] + 2) * flat_width(s["w"])
+        args = (jax.ShapeDtypeStruct((s["b"], lo, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((3, 3, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["c"], s["f"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["f"],), jnp.float32),
+                jax.ShapeDtypeStruct((s["f"],), jnp.float32))
+        return _Partial(_fused_sepconv_tpu, h=s["h"], w=s["w"],
+                        pre_relu=True, post_relu=False,
+                        interpret=True), args
+
+    def build_tiled():
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.ops.sepconv import (_fused_sepconv_tpu_tiled,
+                                             flat_rows, flat_width)
+
+        s = _KERNEL_SHAPES["sepconv_tiled"]
+        lo = flat_rows(s["h"], s["th"]) * flat_width(s["w"])
+        args = (jax.ShapeDtypeStruct((s["b"], lo, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((3, 3, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["c"], s["f"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["f"],), jnp.float32),
+                jax.ShapeDtypeStruct((s["f"],), jnp.float32))
+        return _Partial(_fused_sepconv_tpu_tiled, h=s["h"], w=s["w"],
+                        th=s["th"], pre_relu=True, post_relu=False,
+                        interpret=True), args
+
+    def build_mbconv():
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.ops.sepconv import _fused_mbconv_tpu, flat_width
+
+        s = _KERNEL_SHAPES["mbconv"]
+        lo = (s["h"] + 2) * flat_width(s["w"])
+        args = (jax.ShapeDtypeStruct((s["b"], lo, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((3, 3, s["c"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["c"], s["f"]), jnp.bfloat16),
+                jax.ShapeDtypeStruct((s["c"],), jnp.float32),
+                jax.ShapeDtypeStruct((s["f"],), jnp.float32))
+        return _Partial(_fused_mbconv_tpu, h=s["h"], w=s["w"],
+                        interpret=True), args
+
+    base = dict(kind="kernel", donate=(),
+                donate_reason=SEPCONV_DONATE_REASON,
+                compute_dtype="bfloat16")
+    s1 = _KERNEL_SHAPES["sepconv"]
+    s2 = _KERNEL_SHAPES["sepconv_tiled"]
+    s3 = _KERNEL_SHAPES["mbconv"]
+    return [
+        ProgramSpec(name=f"kernel/sepconv/{s1['h']}x{s1['w']}x{s1['c']}",
+                    build=build_sepconv, batch_rows=s1["b"],
+                    group="kernel/sepconv", **base),
+        ProgramSpec(
+            name=f"kernel/sepconv_tiled/{s2['h']}x{s2['w']}x{s2['c']}",
+            build=build_tiled, batch_rows=s2["b"],
+            group="kernel/sepconv_tiled", **base),
+        ProgramSpec(name=f"kernel/mbconv/{s3['h']}x{s3['w']}x{s3['c']}",
+                    build=build_mbconv, batch_rows=s3["b"],
+                    group="kernel/mbconv", **base),
+    ]
+
+
+class _Partial:
+    """A static-kwarg binder exposing the jit object's ``lower``: the
+    sepconv jits take their shape parameters as ``static_argnames``, so
+    the audit lowers them with those bound."""
+
+    def __init__(self, jitted, **static_kwargs):
+        self._jitted = jitted
+        self._kw = static_kwargs
+
+    def lower(self, *args):
+        return self._jitted.lower(*args, **self._kw)
+
+
+def stack_programs(max_batch_size: int = 32,
+                   models: Optional[Sequence[str]] = None,
+                   compute_dtype: str = "bfloat16",
+                   include_train: bool = True,
+                   include_kernels: bool = True,
+                   mesh=None) -> List[ProgramSpec]:
+    """The full auditable inventory: zoo x bucket plan (+ the train-step
+    and sepconv-kernel programs unless excluded).  ``models`` narrows
+    the zoo sweep (the tier-1 acceptance gate audits a small subset;
+    ``tools/graftcheck.py`` sweeps everything)."""
+    specs = zoo_dispatch_specs(max_batch_size=max_batch_size,
+                               models=models, compute_dtype=compute_dtype,
+                               mesh=mesh)
+    if include_train:
+        # the train batch is the estimator's default fit batch, NOT a
+        # serving bucket — keep it fixed so subset audits (--models /
+        # --max-batch) still line up with the committed baseline
+        specs.extend(train_step_specs(mesh=mesh))
+    if include_kernels:
+        specs.extend(sepconv_kernel_specs())
+    return specs

@@ -1,0 +1,108 @@
+"""SDL007 — every jit call site makes an explicit donation decision.
+
+Buffer donation is the cheapest device-memory win the stack has
+(ROADMAP item 3): a dispatch-path program that forgets
+``donate_argnums`` silently doubles its peak residency, and nothing at
+runtime ever complains.  The rule forces the decision to be VISIBLE at
+every ``jax.jit`` call site:
+
+* pass ``donate_argnums=...`` / ``donate_argnames=...`` explicitly — an
+  explicit empty tuple counts: it says "considered, and no donation is
+  safe here", which is a decision, not an omission; or
+* carry ``# graftlint: allow=SDL007 reason=<why donation is unsafe or
+  pointless>``.
+
+Both the direct form (``jax.jit(fn, ...)``) and the decorator-factory
+form (``functools.partial(jax.jit, ...)`` — ops/sepconv.py's idiom) are
+checked.  The deeper program-level half of this invariant — whether a
+DECLARED donation actually establishes an input/output alias once
+lowered — is graftcheck GC001 (``analysis.program``); SDL007 is the
+source-level gate that keeps new call sites from skipping the question
+entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from sparkdl_tpu.analysis.core import Finding, LintContext, Module
+
+_DONATE_KW = {"donate_argnums", "donate_argnames"}
+
+
+def _jit_name_tables(tree: ast.AST) -> tuple:
+    """``(jax_module_aliases, direct_jit_names, partial_names)``: names
+    the ``jax`` module is bound to, names ``jax.jit`` itself is bound to
+    (``from jax import jit [as j]``), and names ``functools.partial`` is
+    callable under (``functools`` aliases handled at the call site)."""
+    jax_mods: Set[str] = set()
+    direct: Set[str] = set()
+    functools_mods: Set[str] = set()
+    partial_names: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for alias in n.names:
+                if alias.name == "jax":
+                    jax_mods.add(alias.asname or "jax")
+                elif alias.name == "functools":
+                    functools_mods.add(alias.asname or "functools")
+        elif isinstance(n, ast.ImportFrom):
+            if n.module == "jax":
+                for alias in n.names:
+                    if alias.name == "jit":
+                        direct.add(alias.asname or "jit")
+            elif n.module == "functools":
+                for alias in n.names:
+                    if alias.name == "partial":
+                        partial_names.add(alias.asname or "partial")
+    return jax_mods, direct, functools_mods, partial_names
+
+
+def rule_sdl007(module: Module, ctx: LintContext) -> List[Finding]:
+    jax_mods, direct, functools_mods, partial_names = _jit_name_tables(
+        module.tree)
+
+    def is_jit_ref(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute):
+            return (node.attr == "jit" and isinstance(node.value, ast.Name)
+                    and node.value.id in jax_mods)
+        return isinstance(node, ast.Name) and node.id in direct
+
+    def is_partial_ref(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute):
+            return (node.attr == "partial"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in functools_mods)
+        return isinstance(node, ast.Name) and node.id in partial_names
+
+    findings: List[Finding] = []
+
+    def report(form: str, lineno: int) -> None:
+        findings.append(Finding(
+            "SDL007", module.path, lineno,
+            f"{form} without an explicit donate_argnums/donate_argnames; "
+            f"decide donation at every jit site (an explicit empty tuple "
+            f"records 'no donation is safe here') or annotate why the "
+            f"question does not apply"))
+
+    for node in ast.walk(module.tree):
+        # the bare decorator form has NO Call node: @jax.jit / @jit
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_ref(dec):
+                    report("@jax.jit (bare decorator)", dec.lineno)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        if is_jit_ref(node.func):
+            form = "jax.jit(...)"
+        elif (is_partial_ref(node.func) and node.args
+                and is_jit_ref(node.args[0])):
+            form = "functools.partial(jax.jit, ...)"
+        else:
+            continue
+        if any(kw.arg in _DONATE_KW for kw in node.keywords):
+            continue
+        report(form, node.lineno)
+    return findings

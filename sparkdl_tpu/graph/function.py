@@ -95,4 +95,5 @@ class ModelFunction:
         """Eagerly jit-compile (otherwise the engine jits with shardings)."""
         import jax
 
+        # graftlint: allow=SDL007 reason=generic API: the caller owns both variables and x across calls; donation is decided at the engine layer
         return jax.jit(self.fn)

@@ -108,8 +108,14 @@ class EfficientNetB0(nn.Module):
                                 momentum=0.99, epsilon=1e-3, name=name)
 
         # Input pipeline lives IN the model (keras parity): rescale then
-        # the weights-carrying normalization.
-        x = x / jnp.float32(255.0)
+        # the weights-carrying normalization.  The rescale divides in the
+        # input's own float dtype — a concrete f32 divisor would upcast a
+        # bf16 program (and every conv after it) to f32 (graftcheck
+        # GC002); integer inputs (the uint8 default path) still promote
+        # to f32 exactly as before.
+        rescale_dtype = (x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                         else jnp.float32)
+        x = x / jnp.asarray(255.0, rescale_dtype)
         x = InputNorm(name="normalization")(x)
 
         x = _correct_pad(x, 3)

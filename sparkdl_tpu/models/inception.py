@@ -191,9 +191,14 @@ class InceptionV3(nn.Module):
                 return nn.max_pool(x, (p.window, p.window),
                                    strides=(p.stride, p.stride),
                                    padding=p.padding)
+            # flax divides by f32 window counts under count_include_pad=
+            # False, which would upcast a bf16 program — and every conv
+            # downstream of the branch concat — to f32 (graftcheck GC002);
+            # the cast is a no-op in the default f32 path
             return nn.avg_pool(x, (p.window, p.window),
                                strides=(p.stride, p.stride),
-                               padding=p.padding, count_include_pad=False)
+                               padding=p.padding,
+                               count_include_pad=False).astype(x.dtype)
 
         def run(x, ops: Sequence[Op]):
             for op in ops:

@@ -136,6 +136,7 @@ def _sepconv_kernel(x_ref, dwk_ref, pw_ref, scale_ref, shift_ref, out_ref,
     out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
 
 
+# graftlint: allow=SDL007 reason=xf is a chained flat activation the caller may reuse (Xception residual adds); donation would corrupt the residual source
 @functools.partial(
     jax.jit,
     static_argnames=("h", "w", "pre_relu", "post_relu", "interpret"))
@@ -207,6 +208,7 @@ def _sepconv_tiled_kernel(above_ref, cur_ref, below_ref, dwk_ref, pw_ref,
     out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
 
 
+# graftlint: allow=SDL007 reason=xf is a chained flat activation the caller may reuse (residual adds), and it feeds all three halo views; donation would corrupt them
 @functools.partial(
     jax.jit,
     static_argnames=("h", "w", "th", "pre_relu", "post_relu", "interpret"))
@@ -283,6 +285,7 @@ def _mbconv_kernel(x_ref, dwk_ref, pw_ref, mid_shift_ref, shift_ref,
     out_ref[0] = jnp.where(valid, y, 0.0).astype(out_ref.dtype)
 
 
+# graftlint: allow=SDL007 reason=xf is a chained flat activation the caller may reuse (MobileNet inverted-residual add); donation would corrupt the residual source
 @functools.partial(jax.jit, static_argnames=("h", "w", "interpret"))
 def _fused_mbconv_tpu(xf, dwk, pw, mid_shift, shift, h, w,
                       interpret=False):

@@ -200,6 +200,83 @@ def clear_engine_jit_cache() -> None:
     _JIT_CACHE.clear()
 
 
+def resolve_engine_mesh(mesh=None):
+    """The mesh an :class:`InferenceEngine` actually runs on when the
+    caller passes ``mesh`` (possibly None).  Scoring is per-controller by
+    design (PERF.md topology envelope): under multi-controller jax the
+    default covers LOCAL devices only, and an explicit cross-process mesh
+    is refused loudly — device_put of process-local numpy onto a global
+    sharding fails confusingly at runtime.  Shared with the serving
+    bucket plan and ``analysis.program`` so enumerated programs see the
+    same topology the engine compiles for."""
+    import jax
+
+    if mesh is None:
+        if jax.process_count() > 1:
+            mesh = mesh_lib.get_mesh(devices=jax.local_devices())
+        else:
+            mesh = mesh_lib.get_mesh()
+    if jax.process_count() > 1 and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat):
+        raise NotImplementedError(
+            "InferenceEngine is single-controller: pass a mesh over "
+            "this process's local devices (mesh.get_mesh(devices="
+            "jax.local_devices())) and shard input rows per host; "
+            "multi-controller collectives belong to the TRAIN path "
+            "(parallel.train / parallel.distributed).")
+    return mesh
+
+
+def effective_device_batch(device_batch_size: int, mesh) -> int:
+    """The device batch the engine actually compiles for: rounded UP to a
+    multiple of the mesh's data-axis size so every chip gets identical
+    work.  Single-sourced so the serving bucket plan and the program
+    auditor (``analysis.program``) enumerate exactly the shapes
+    :class:`InferenceEngine` builds."""
+    dp = mesh.shape[mesh_lib.DATA_AXIS]
+    b = max(1, int(device_batch_size))
+    rem = b % dp
+    return b + (dp - rem) if rem else b
+
+
+def build_dispatch_jit(fn: Callable, mesh, donate_batch: bool):
+    """THE per-batch dispatch program: ``jit(fn)`` with params replicated,
+    batch sharded on the data axis, and the batch donated when asked.
+    :class:`InferenceEngine` compiles through this (via the module jit
+    cache) and ``analysis.program`` lowers the same object abstractly —
+    one constructor, so the audited program cannot drift from the served
+    one."""
+    import jax
+
+    return jax.jit(
+        fn,
+        in_shardings=(mesh_lib.replicated_sharding(mesh),
+                      mesh_lib.batch_sharding(mesh)),
+        out_shardings=mesh_lib.batch_sharding(mesh),
+        donate_argnums=(1,) if donate_batch else ())
+
+
+def build_grouped_dispatch_jit(fn: Callable, mesh, donate_batch: bool,
+                               batches_per_dispatch: int):
+    """The grouped (``batches_per_dispatch`` > 1) dispatch program: one
+    ``lax.map`` launch over a stacked leading group axis.  Shared with
+    ``analysis.program`` exactly like :func:`build_dispatch_jit`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    group_sh = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
+
+    def fn_group(v, xs):
+        return jax.lax.map(lambda x: fn(v, x), xs)
+
+    return jax.jit(
+        fn_group,
+        in_shardings=(mesh_lib.replicated_sharding(mesh), group_sh),
+        out_shardings=group_sh,
+        donate_argnums=(1,) if donate_batch else ())
+
+
 def batches_per_dispatch_from_env() -> int:
     """``SPARKDL_BATCHES_PER_DISPATCH`` (clamped to >= 1) — the one
     parser every engine-constructing site shares, so cache keys and
@@ -267,34 +344,15 @@ class InferenceEngine:
         import jax
 
         # Scoring is per-controller by design (PERF.md topology
-        # envelope): each host scores its own rows on its own devices.
-        # Under multi-controller jax the default mesh therefore covers
-        # LOCAL devices only (the zoo transformers pass no mesh, so this
-        # keeps them working on pods), and an EXPLICIT cross-process
-        # mesh is refused loudly — device_put of process-local numpy
-        # onto a global sharding fails confusingly at runtime.
-        if mesh is not None:
-            self.mesh = mesh
-        elif jax.process_count() > 1:
-            self.mesh = mesh_lib.get_mesh(devices=jax.local_devices())
-        else:
-            self.mesh = mesh_lib.get_mesh()
-        if jax.process_count() > 1 and any(
-                d.process_index != jax.process_index()
-                for d in self.mesh.devices.flat):
-            raise NotImplementedError(
-                "InferenceEngine is single-controller: pass a mesh over "
-                "this process's local devices (mesh.get_mesh(devices="
-                "jax.local_devices())) and shard input rows per host; "
-                "multi-controller collectives belong to the TRAIN path "
-                "(parallel.train / parallel.distributed).")
+        # envelope): each host scores its own rows on its own devices —
+        # see resolve_engine_mesh (the zoo transformers pass no mesh, so
+        # the local-devices default keeps them working on pods).
+        self.mesh = resolve_engine_mesh(mesh)
         self.data_parallel = self.mesh.shape[mesh_lib.DATA_AXIS]
         # Round the device batch up to a multiple of the data-axis size so
         # every chip gets identical work.
-        b = max(1, int(device_batch_size))
-        rem = b % self.data_parallel
-        if rem:
-            b += self.data_parallel - rem
+        b = effective_device_batch(device_batch_size, self.mesh)
+        if b != max(1, int(device_batch_size)):
             logger.info("device_batch_size rounded up to %d (multiple of "
                         "%d-way data axis)", b, self.data_parallel)
         self.device_batch_size = b
@@ -347,11 +405,7 @@ class InferenceEngine:
         key = (id(fn),) + mesh_key + (1,)
         compiled = _JIT_CACHE.get(key)
         if compiled is None:
-            compiled = jax.jit(
-                fn,
-                in_shardings=(self._replicated, self._batch_sharding),
-                out_shardings=self._batch_sharding,
-                donate_argnums=(1,) if donate_batch else ())
+            compiled = build_dispatch_jit(fn, self.mesh, donate_batch)
             _JIT_CACHE.put(key, compiled)
         # the plain per-batch program always exists: it runs run_padded
         # and the ragged tail group (cheaper than padding a group with
@@ -361,19 +415,8 @@ class InferenceEngine:
             gkey = (id(fn),) + mesh_key + (self.batches_per_dispatch,)
             grouped = _JIT_CACHE.get(gkey)
             if grouped is None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                group_sh = NamedSharding(
-                    self.mesh, P(None, mesh_lib.DATA_AXIS))
-
-                def fn_group(v, xs):
-                    return jax.lax.map(lambda x: fn(v, x), xs)
-
-                grouped = jax.jit(
-                    fn_group,
-                    in_shardings=(self._replicated, group_sh),
-                    out_shardings=group_sh,
-                    donate_argnums=(1,) if donate_batch else ())
+                grouped = build_grouped_dispatch_jit(
+                    fn, self.mesh, donate_batch, self.batches_per_dispatch)
                 _JIT_CACHE.put(gkey, grouped)
             self._compiled_group = grouped
 

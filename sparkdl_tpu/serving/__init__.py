@@ -32,10 +32,11 @@ from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError, QueueFullError,
                                         ServerClosedError,
                                         ServiceUnavailableError, ServingError)
-from sparkdl_tpu.serving.server import Server
+from sparkdl_tpu.serving.server import Server, bucket_plan
 
 __all__ = [
     "Server",
+    "bucket_plan",
     "from_transformer",
     "DynamicBatcher",
     "Request",

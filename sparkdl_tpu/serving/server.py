@@ -68,36 +68,23 @@ def _resolve_model(model, variables, featurize: bool):
         if variables is not None:
             raise ValueError("variables must be None when serving a named "
                              "zoo model")
-        import os
+        from sparkdl_tpu.transformers.named_image import (
+            _cached_model, zoo_compute_dtype_name, zoo_model_fn)
 
-        from sparkdl_tpu.models import get_model_spec
-        from sparkdl_tpu.transformers.named_image import _cached_model
-
-        spec = get_model_spec(model)
         module, zoo_vars = _cached_model(model)
-        pre = spec.preprocess
-        cdt_name = os.environ.get("SPARKDL_ZOO_COMPUTE_DTYPE", "").lower()
-        if cdt_name not in ("", "float32", "f32", "bfloat16", "bf16"):
-            raise ValueError(
-                f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; "
-                f"use 'bfloat16' or 'float32'")
-        bf16 = cdt_name in ("bfloat16", "bf16")
+        cdt = None
         overrides = {}
-        if bf16:
+        if zoo_compute_dtype_name() == "bfloat16":
             import jax.numpy as jnp
             import numpy as _np
 
+            cdt = jnp.bfloat16
             overrides = {"compute_dtype": jnp.bfloat16,
                          "output_host_dtype": _np.float32}
-
-        def fn(v, x):  # x: uint8 RGB [B,H,W,3]
-            xf = pre(x)
-            if bf16:
-                import jax.numpy as jnp
-
-                xf = xf.astype(jnp.bfloat16)
-            return module.apply(v, xf, train=False, features=featurize)
-
+        # the ONE zoo fn constructor — shared with _zoo_engine and the
+        # program auditor, so served == transformed == audited
+        fn = zoo_model_fn(model, featurize=featurize, compute_dtype=cdt,
+                          module=module)
         return fn, zoo_vars, overrides
     if isinstance(model, ModelFunction):
         if variables is not None:
@@ -116,6 +103,34 @@ def _default_buckets(max_batch_size: int) -> List[int]:
     medium, and saturated traffic without per-count recompiles."""
     b = max(1, int(max_batch_size))
     return sorted({max(1, b // 4), max(1, b // 2), b})
+
+
+def bucket_plan(max_batch_size: int,
+                bucket_sizes: Optional[Sequence[int]] = None,
+                mesh=None) -> List[int]:
+    """The COMPILED bucket set a :class:`Server` would build: requested
+    buckets (default quarter/half/full), validated, rounded up to the
+    mesh's data-axis multiple (the engine does this per bucket anyway),
+    and de-duplicated — two raw buckets that round to the same device
+    batch were two engine objects compiling ONE shape.  This is the
+    enumeration hook ``analysis.program`` walks to audit every serving
+    program chip-free; the server itself builds its engines from the
+    same plan so the audited set cannot drift from the served set."""
+    from sparkdl_tpu.parallel.engine import (effective_device_batch,
+                                             resolve_engine_mesh)
+
+    max_batch_size = max(1, int(max_batch_size))
+    buckets = (list(bucket_sizes) if bucket_sizes is not None
+               else _default_buckets(max_batch_size))
+    if not buckets or any(int(b) < 1 for b in buckets):
+        raise ValueError(f"bucket_sizes must be positive, got {buckets}")
+    buckets = sorted(int(b) for b in buckets)
+    if buckets[-1] < max_batch_size:
+        raise ValueError(
+            f"largest bucket ({buckets[-1]}) must cover "
+            f"max_batch_size ({max_batch_size})")
+    mesh = resolve_engine_mesh(mesh)
+    return sorted({effective_device_batch(b, mesh) for b in buckets})
 
 
 class _Once:
@@ -227,15 +242,10 @@ class Server:
             output_host_dtype = _overrides.get("output_host_dtype")
         self.metrics = metrics if metrics is not None else Metrics()
         self.max_batch_size = max(1, int(max_batch_size))
-        buckets = (list(bucket_sizes) if bucket_sizes is not None
-                   else _default_buckets(self.max_batch_size))
-        if not buckets or any(int(b) < 1 for b in buckets):
-            raise ValueError(f"bucket_sizes must be positive, got {buckets}")
-        self._buckets = sorted(int(b) for b in buckets)
-        if self._buckets[-1] < self.max_batch_size:
-            raise ValueError(
-                f"largest bucket ({self._buckets[-1]}) must cover "
-                f"max_batch_size ({self.max_batch_size})")
+        # mesh-rounded, de-duplicated compiled shapes; also what the
+        # program auditor enumerates (bucket_plan docstring)
+        self._buckets = bucket_plan(self.max_batch_size,
+                                    bucket_sizes=bucket_sizes, mesh=mesh)
         self._default_timeout_s = (None if default_timeout_ms is None
                                    else max(0.0, default_timeout_ms) / 1e3)
         self._dispatch_timeout_s = (None if dispatch_timeout_ms is None

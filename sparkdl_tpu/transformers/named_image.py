@@ -57,6 +57,46 @@ def _cached_model(name: str):
     return _MODEL_CACHE[key]
 
 
+def zoo_compute_dtype_name() -> str:
+    """Canonicalized ``SPARKDL_ZOO_COMPUTE_DTYPE`` ("float32" or
+    "bfloat16"); raises on unsupported values.  One parser for the engine
+    cache key, the serving resolver, and the program auditor — the
+    declared compute dtype graftcheck GC002 enforces must be read the
+    same way everywhere."""
+    import os
+
+    cdt_name = os.environ.get("SPARKDL_ZOO_COMPUTE_DTYPE", "").lower()
+    if cdt_name not in ("", "float32", "f32", "bfloat16", "bf16"):
+        raise ValueError(
+            f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; use "
+            f"'bfloat16' or 'float32'")
+    return {"bf16": "bfloat16", "f32": "float32", "": "float32"}.get(
+        cdt_name, cdt_name)
+
+
+def zoo_model_fn(name: str, featurize: bool, compute_dtype=None,
+                 module=None):
+    """THE ``fn(variables, x)`` the zoo engine jit-compiles: fused
+    preprocess, optional cast to the compute dtype, inference-mode apply
+    at the featurizer or predictor cut.  ``module`` defaults to a fresh
+    ``spec.build()`` — the program auditor (``analysis.program``) builds
+    the fn this way with abstract variables (no weights, no device), so
+    the audited program is the served program by construction."""
+    spec = get_model_spec(name)
+    if module is None:
+        module = spec.build()
+    pre = spec.preprocess
+    cdt = compute_dtype
+
+    def fn(v, x):  # x: uint8 RGB [B,H,W,3]
+        xf = pre(x)
+        if cdt is not None:
+            xf = xf.astype(cdt)
+        return module.apply(v, xf, train=False, features=featurize)
+
+    return fn
+
+
 def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
     """One cached engine per (model, cut, batch).
 
@@ -66,16 +106,7 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
     bytes).  Default stays float32: the reference's scoring contract is
     f32 end-to-end and the parity oracles are f32.
     """
-    import os
-
-    cdt_name = os.environ.get("SPARKDL_ZOO_COMPUTE_DTYPE", "").lower()
-    if cdt_name not in ("", "float32", "f32", "bfloat16", "bf16"):
-        raise ValueError(
-            f"SPARKDL_ZOO_COMPUTE_DTYPE={cdt_name!r} not supported; use "
-            f"'bfloat16' or 'float32'")
-    # canonicalize before keying: 'bf16' and 'bfloat16' are one engine
-    cdt_name = {"bf16": "bfloat16", "f32": "float32", "": "float32"}.get(
-        cdt_name, cdt_name)
+    cdt_name = zoo_compute_dtype_name()
     bpd = batches_per_dispatch_from_env()
     key = (name, model_variant_key(name), featurize, batch_size, cdt_name,
            bpd)
@@ -84,16 +115,8 @@ def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
         import jax.numpy as jnp
 
         module, variables = _cached_model(name)
-        spec = get_model_spec(name)
-        pre = spec.preprocess
-        cdt = jnp.bfloat16 if cdt_name in ("bfloat16", "bf16") else None
-
-        def fn(v, x):  # x: uint8 RGB [B,H,W,3]
-            xf = pre(x)
-            if cdt is not None:
-                xf = xf.astype(cdt)
-            return module.apply(v, xf, train=False, features=featurize)
-
+        cdt = jnp.bfloat16 if cdt_name == "bfloat16" else None
+        fn = zoo_model_fn(name, featurize, compute_dtype=cdt, module=module)
         eng = InferenceEngine(
             fn, variables, device_batch_size=batch_size,
             compute_dtype=cdt,

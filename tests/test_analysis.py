@@ -384,6 +384,82 @@ def test_sdl006_stamps_and_perf_counter_pass():
 
 
 # ---------------------------------------------------------------------------
+# SDL007 — explicit donation decision at every jit site (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_sdl007_bare_jit_fires():
+    src = ("import jax\n"
+           "def f(fn):\n"
+           "    return jax.jit(fn)\n")
+    assert codes(src) == ["SDL007"]
+    from_import = ("from jax import jit\n"
+                   "def f(fn):\n"
+                   "    return jit(fn)\n")
+    assert codes(from_import) == ["SDL007"]
+
+
+def test_sdl007_partial_decorator_form_fires():
+    src = ("import functools\n"
+           "import jax\n"
+           "@functools.partial(jax.jit, static_argnames=('h',))\n"
+           "def f(x, h):\n"
+           "    return x\n")
+    assert codes(src) == ["SDL007"]
+
+
+def test_sdl007_bare_decorator_form_fires():
+    # no Call node exists for @jax.jit — the decorator list is checked
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x\n")
+    assert codes(src) == ["SDL007"]
+    from_import = ("from jax import jit\n"
+                   "@jit\n"
+                   "def f(x):\n"
+                   "    return x\n")
+    assert codes(from_import) == ["SDL007"]
+
+
+def test_sdl007_explicit_decision_passes():
+    empty = ("import jax\n"
+             "def f(fn):\n"
+             "    return jax.jit(fn, donate_argnums=())\n")
+    donated = ("import jax\n"
+               "def f(fn):\n"
+               "    return jax.jit(fn, donate_argnames=('x',))\n")
+    partial = ("import functools\n"
+               "import jax\n"
+               "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+               "def f(x):\n"
+               "    return x\n")
+    assert codes(empty) == []
+    assert codes(donated) == []
+    assert codes(partial) == []
+
+
+def test_sdl007_pragma_needs_reason():
+    with_reason = ("import jax\n"
+                   "def f(fn):\n"
+                   "    # graftlint: allow=SDL007 reason=one-shot probe\n"
+                   "    return jax.jit(fn)\n")
+    assert codes(with_reason) == []
+    bare = ("import jax\n"
+            "def f(fn):\n"
+            "    # graftlint: allow=SDL007\n"
+            "    return jax.jit(fn)\n")
+    # a reason-less pragma is itself a finding AND suppresses nothing
+    assert codes(bare) == ["SDL000", "SDL007"]
+
+
+def test_sdl007_ignores_non_jax_jit():
+    src = ("import numba\n"
+           "def f(fn):\n"
+           "    return numba.jit(fn)\n")
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
 # the repo itself must lint clean (the acceptance gate, in-tree)
 # ---------------------------------------------------------------------------
 
@@ -417,6 +493,32 @@ def test_cli_exit_codes(tmp_path):
     assert r.returncode == 0
     for code in RULE_HELP:
         assert code in r.stdout
+
+
+def test_cli_json_output(tmp_path):
+    """--json (ISSUE 6 satellite): stable machine-readable findings for
+    CI — rule/path/line/message per finding, exit codes unchanged."""
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(fn):\n"
+                   "    return jax.jit(fn)\n")
+    cli = os.path.join(REPO, "tools", "graftlint.py")
+    r = subprocess.run([sys.executable, cli, "--json", str(bad)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["files"] == 1 and doc["rules"] == len(RULE_HELP)
+    [finding] = doc["findings"]
+    assert finding["rule"] == "SDL007"
+    assert finding["path"] == str(bad) and finding["line"] == 3
+    assert "donate_argnums" in finding["message"]
+    bad.write_text("X = 1\n")
+    r = subprocess.run([sys.executable, cli, "--json", str(bad)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["findings"] == []
 
 
 def test_cli_sites_file_option(tmp_path):

@@ -1,0 +1,431 @@
+"""graftcheck: program-level rules GC001–GC005, the lockfile contract,
+the CLI, and the repo-audits-clean acceptance gate (ISSUE 6).
+
+Budget discipline: the per-rule fixtures are tiny matmul programs
+(abstract lowering only — fractions of a second each); the one
+real-model audit is MobileNetV2 at a single bucket, shared by the
+acceptance gate and the CLI/--json test.  Everything stays well under
+the tier-1 headroom (~720-780 s of the 870 s driver window).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.analysis.program import (ProgramSpec, audit_inventory,
+                                          audit_program, diff_records,
+                                          pad_waste_audit, read_lockfile,
+                                          retrace_audit, stack_programs,
+                                          write_lockfile, zoo_gflop_per_img)
+from sparkdl_tpu.parallel import mesh as mesh_lib
+from sparkdl_tpu.parallel.engine import build_dispatch_jit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCKFILE = os.path.join(REPO, "PROGRAMS.lock.json")
+
+D = 16  # feature dim of the synthetic programs
+
+
+def _axes(mesh):
+    return {str(n): int(s)
+            for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def _dispatch_spec(fn, *, rows=8, compute_dtype=None, donate_reason="n/a",
+                   name="synth/dispatch", in_dtype=np.float32,
+                   mesh=None, param_shape=(D, D)):
+    """A small engine-style dispatch program over the test mesh."""
+    mesh = mesh if mesh is not None else mesh_lib.get_mesh()
+
+    def build():
+        jitted = build_dispatch_jit(fn, mesh, donate_batch=False)
+        v = {"w": jax.ShapeDtypeStruct(param_shape, np.float32)}
+        x = jax.ShapeDtypeStruct((rows, param_shape[0]), in_dtype)
+        return jitted, (v, x)
+
+    return ProgramSpec(name=name, kind="dispatch", build=build,
+                       compute_dtype=compute_dtype,
+                       donate_reason=donate_reason, batch_rows=rows,
+                       shardings=("replicated", "batch"),
+                       mesh_axes=_axes(mesh), group=name)
+
+
+def _train_spec(*, donate=(0,), out_dtype=None, name="synth/train",
+                donate_reason=None):
+    """A train-style program: params in, params out (donatable unless
+    ``out_dtype`` breaks the alias)."""
+    mesh = mesh_lib.get_mesh()
+    repl = mesh_lib.replicated_sharding(mesh)
+    bsh = mesh_lib.batch_sharding(mesh)
+
+    def step(p, x):
+        g = x.T @ x @ p["w"]
+        new = {"w": p["w"] - 0.1 * g}
+        if out_dtype is not None:
+            new = {"w": new["w"].astype(out_dtype)}
+        return new, jnp.mean(g)
+
+    def build():
+        jitted = jax.jit(step, in_shardings=(repl, bsh),
+                         out_shardings=(repl, repl),
+                         donate_argnums=donate)
+        p = {"w": jax.ShapeDtypeStruct((D, D), np.float32)}
+        x = jax.ShapeDtypeStruct((8, D), np.float32)
+        return jitted, (p, x)
+
+    return ProgramSpec(name=name, kind="train", build=build, donate=donate,
+                       donate_reason=donate_reason, batch_rows=8,
+                       shardings=("replicated", "batch"),
+                       mesh_axes=_axes(mesh), group=name)
+
+
+# ---------------------------------------------------------------------------
+# GC001 — donation
+# ---------------------------------------------------------------------------
+
+def test_gc001_missing_donation_fires():
+    spec = _train_spec(donate=())
+    out = audit_program(spec)
+    assert [f.code for f in out["findings"]] == ["GC001"]
+    assert "donates nothing" in out["findings"][0].message
+
+
+def test_gc001_established_alias_passes():
+    out = audit_program(_train_spec(donate=(0,)))
+    assert out["findings"] == []
+    d = out["record"]["donation"]
+    assert d["donated_leaves"] == 1 and d["aliased"] >= 1
+
+
+def test_gc001_dropped_donation_fires():
+    # params f32 in but bf16 out: XLA cannot alias, donation is silently
+    # dropped — exactly the regression class GC001 exists for
+    out = audit_program(_train_spec(donate=(0,), out_dtype=jnp.bfloat16))
+    assert [f.code for f in out["findings"]] == ["GC001"]
+    assert "silently dropped" in out["findings"][0].message
+
+
+def test_gc001_reason_exempts():
+    spec = _train_spec(donate=(), donate_reason="caller reuses params")
+    assert audit_program(spec)["findings"] == []
+    rec = audit_program(spec)["record"]
+    assert rec["donation"]["reason"] == "caller reuses params"
+
+
+# ---------------------------------------------------------------------------
+# GC002 — dtype leaks
+# ---------------------------------------------------------------------------
+
+def _bf16_fn(leak: bool):
+    def fn(v, x):
+        xc = x.astype(jnp.float32 if leak else jnp.bfloat16)
+        w = v["w"].astype(xc.dtype)
+        return xc @ w
+
+    return fn
+
+
+def test_gc002_f32_dot_under_bf16_fires():
+    out = audit_program(_dispatch_spec(_bf16_fn(leak=True),
+                                       compute_dtype="bfloat16"))
+    assert [f.code for f in out["findings"]] == ["GC002"]
+    assert out["record"]["dtype_counts"].get("dot_f32", 0) >= 1
+
+
+def test_gc002_bf16_clean_and_f32_config_exempt():
+    clean = audit_program(_dispatch_spec(_bf16_fn(leak=False),
+                                         compute_dtype="bfloat16"))
+    assert clean["findings"] == []
+    assert clean["record"]["dtype_counts"].get("dot_bf16", 0) >= 1
+    # the same leaky program audited under a declared f32 config is fine
+    f32 = audit_program(_dispatch_spec(_bf16_fn(leak=True),
+                                       compute_dtype="float32"))
+    assert f32["findings"] == []
+
+
+def test_gc002_bf16_accumulate_f32_is_not_a_leak():
+    # bf16 operands + preferred_element_type=f32 is the kernels'
+    # deliberate precision contract (sepconv), not an upcast leak
+    def fn(v, x):
+        return jax.lax.dot_general(
+            x.astype(jnp.bfloat16), v["w"].astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    out = audit_program(_dispatch_spec(fn, compute_dtype="bfloat16"))
+    assert out["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# GC003 — retrace / cache keys
+# ---------------------------------------------------------------------------
+
+def test_gc003_weak_type_fires():
+    def fn(v, x):
+        return x * v["w"][0, 0]
+
+    def build():
+        # a plain jit (no shardings) traced with a python float: the
+        # scalar enters the signature as a WEAK f32 aval
+        jitted = jax.jit(fn, donate_argnums=())
+        v = {"w": jax.ShapeDtypeStruct((D, D), np.float32)}
+        return jitted, (v, 3.0)
+
+    spec = ProgramSpec(name="synth/weak", kind="dispatch", build=build,
+                       donate_reason="n/a", group="synth/weak")
+    records, findings = audit_inventory([spec])
+    assert any(f.code == "GC003" and "weak-typed" in f.message
+               for f in findings)
+    assert records[0]["in_avals"]["weak"] == 1
+
+
+def test_gc003_duplicate_and_churn():
+    a = audit_program(_dispatch_spec(_bf16_fn(False),
+                                     name="synth/dup"))["record"]
+    b = dict(a, name="synth/dup2")
+    dup = retrace_audit([a, dict(a, name="synth/dup-copy")])
+    assert any(f.code == "GC003" and "duplicate" in f.message for f in dup)
+    # same shapes, different dtype signature in one group -> churn
+    b["group"] = a["group"]
+    b["in_avals"] = dict(a["in_avals"], key="different-dtype-key")
+    churn = retrace_audit([a, b])
+    assert any(f.code == "GC003" and "churn" in f.message for f in churn)
+    assert retrace_audit([a]) == []
+
+
+# ---------------------------------------------------------------------------
+# GC004 — pad-waste budget
+# ---------------------------------------------------------------------------
+
+def _bucket_rec(model, bucket, gflop_per_row=1.0):
+    return {"name": f"zoo/{model}/b{bucket}", "model": model,
+            "bucket": bucket, "flops": gflop_per_row * 1e9 * bucket,
+            "in_avals": {"n": 1, "weak": 0, "key": str(bucket),
+                         "shape_key": str(bucket)}}
+
+
+def test_gc004_quarter_half_full_passes():
+    recs = [_bucket_rec("M", b) for b in (8, 16, 32)]
+    assert pad_waste_audit(recs) == []
+
+
+def test_gc004_wide_gap_and_single_bucket_fire():
+    gap = pad_waste_audit([_bucket_rec("M", 8), _bucket_rec("M", 64)])
+    assert any(f.code == "GC004" and "bucket gap" in f.message for f in gap)
+    single = pad_waste_audit([_bucket_rec("M", 64)])
+    assert any(f.code == "GC004" and "smallest bucket" in f.message
+               for f in single)
+
+
+def test_gc004_nonlinear_flops_fire():
+    recs = [_bucket_rec("M", 8), _bucket_rec("M", 16, gflop_per_row=1.2)]
+    out = pad_waste_audit(recs)
+    assert any(f.code == "GC004" and "per-row FLOPs" in f.message
+               for f in out)
+
+
+# ---------------------------------------------------------------------------
+# GC005 — sharding audit
+# ---------------------------------------------------------------------------
+
+def test_gc005_large_replicated_param_with_model_axis_fires():
+    mesh = mesh_lib.get_mesh(model_parallel=2)
+
+    def fn(v, x):
+        return x @ v["w"]
+
+    spec = _dispatch_spec(fn, mesh=mesh, rows=8,
+                          param_shape=(4096, 4096))  # 64 MB leaf
+    out = audit_program(spec)
+    assert any(f.code == "GC005" and "replicated" in f.message
+               for f in out["findings"])
+
+
+def test_gc005_indivisible_batch_fires():
+    # jax refuses the lowering itself (10 rows on a 4-way data axis);
+    # the auditor reports it as a GC005 finding instead of crashing
+    mesh = mesh_lib.get_mesh(model_parallel=2)  # data axis = 4
+    spec = _dispatch_spec(_bf16_fn(False), mesh=mesh, rows=10)
+    out = audit_program(spec)
+    assert any(f.code == "GC005" and "failed to lower" in f.message
+               for f in out["findings"])
+    assert out["record"]["fingerprint"] is None
+
+
+def test_gc005_replicated_on_data_only_mesh_passes():
+    spec = _dispatch_spec(_bf16_fn(False), param_shape=(4096, 4096))
+    assert [f.code for f in audit_program(spec)["findings"]] == []
+
+
+# ---------------------------------------------------------------------------
+# lockfile — round trip, tamper detection, drift classification
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def small_records():
+    specs = [_train_spec(donate=(0,)),
+             _dispatch_spec(_bf16_fn(False), compute_dtype="bfloat16",
+                            name="synth/disp")]
+    records, findings = audit_inventory(specs)
+    assert findings == []
+    return records
+
+
+def test_lockfile_round_trip_and_tamper(tmp_path, small_records):
+    path = str(tmp_path / "lock.json")
+    write_lockfile(small_records, path, meta={"jax_version": "x"})
+    doc = read_lockfile(path)
+    assert doc["meta"]["jax_version"] == "x"
+    assert diff_records(doc, small_records) == []
+
+    # tamper classes -> the GC rule that names them
+    def tampered(mutate):
+        d = json.loads(json.dumps(doc))
+        mutate(d["programs"]["synth/train"])
+        return d
+
+    drift = diff_records(tampered(
+        lambda p: p.update(fingerprint="0" * 64)), small_records)
+    assert [f.code for f in drift] == ["GC000"]
+    drift = diff_records(tampered(
+        lambda p: p["donation"].update(declared=[])), small_records)
+    assert [f.code for f in drift] == ["GC001"]
+    drift = diff_records(tampered(
+        lambda p: p["dtype_counts"].update(dot_f32=9)), small_records)
+    assert [f.code for f in drift] == ["GC002"]
+    drift = diff_records(tampered(
+        lambda p: p["in_avals"].update(key="churned")), small_records)
+    assert [f.code for f in drift] == ["GC003"]
+    drift = diff_records(tampered(
+        lambda p: p.update(flops=p["flops"] * 2)), small_records)
+    assert [f.code for f in drift] == ["GC004"]
+
+
+def test_lockfile_program_set_drift(tmp_path, small_records):
+    path = str(tmp_path / "lock.json")
+    write_lockfile(small_records[:1], path)
+    doc = read_lockfile(path)
+    # new program not in baseline
+    drift = diff_records(doc, small_records)
+    assert any(f.code == "GC003" and "not in the committed" in f.message
+               for f in drift)
+    # program left the stack (full audit) vs narrowed subset audit
+    write_lockfile(small_records, path)
+    doc = read_lockfile(path)
+    drift = diff_records(doc, small_records[:1], subset=False)
+    assert any("not enumerated" in f.message for f in drift)
+    assert diff_records(doc, small_records[:1], subset=True) == []
+
+
+def test_lockfile_schema_version_guard(tmp_path):
+    path = str(tmp_path / "lock.json")
+    with open(path, "w") as fh:
+        json.dump({"schema_version": 99, "programs": {}}, fh)
+    with pytest.raises(ValueError, match="unsupported lockfile schema"):
+        read_lockfile(path)
+
+
+# ---------------------------------------------------------------------------
+# bench denominators ride the lockfile
+# ---------------------------------------------------------------------------
+
+def test_bench_constants_agree_with_lockfile():
+    """The drift gate the ISSUE asks for: bench.py's pinned fallback
+    GF/img constants and the committed lockfile's audited programs must
+    agree — a program change that moves real FLOPs has to update BOTH
+    (constants document the derivation, the lockfile is the live
+    source)."""
+    locked = zoo_gflop_per_img(LOCKFILE)
+    assert locked, "committed PROGRAMS.lock.json missing zoo programs"
+    import bench
+
+    for model, pinned in bench._ZOO_GFLOP_FALLBACK.items():
+        assert model in locked, model
+        assert abs(locked[model] - pinned) / pinned < 0.02, (
+            f"{model}: lockfile {locked[model]:.3f} GF/img vs bench "
+            f"constant {pinned:.3f} — regenerate the baseline or fix "
+            f"the constant")
+        # and bench actually serves the lockfile value
+        assert bench.ZOO_GFLOP_PER_IMG[model] == pytest.approx(
+            locked[model])
+
+
+# ---------------------------------------------------------------------------
+# acceptance gate: the repo audits clean against its committed lockfile
+# ---------------------------------------------------------------------------
+
+def test_repo_subset_audits_clean_against_committed_lockfile():
+    """MobileNetV2 x one bucket + train steps + kernels, audited fresh
+    in-process and diffed (subset mode) against the committed
+    PROGRAMS.lock.json: zero findings, zero drift.  The FULL zoo sweep
+    runs in run-tests.sh's guarded graftcheck stage; this keeps the
+    chip-free contract inside tier-1 at ~a tenth of the cost."""
+    specs = stack_programs(max_batch_size=8, models=["MobileNetV2"])
+    records, findings = audit_inventory(specs)
+    assert findings == [], [f.render() for f in findings]
+    committed = read_lockfile(LOCKFILE)
+    drift = diff_records(committed, records, subset=True)
+    assert drift == [], [f.render() for f in drift]
+
+
+def test_deliberate_mutations_named_by_rule():
+    """The acceptance criterion's two mutations, exercised at the audit
+    layer: dropping donate_argnums fails GC001 BY NAME; forcing an f32
+    upcast under bf16 fails GC002 BY NAME."""
+    dropped = audit_program(_train_spec(donate=()))["findings"]
+    assert [f.code for f in dropped] == ["GC001"]
+    upcast = audit_program(_dispatch_spec(
+        _bf16_fn(leak=True), compute_dtype="bfloat16"))["findings"]
+    assert [f.code for f in upcast] == ["GC002"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_subset_clean(capsys):
+    """graftcheck --json over the MobileNetV2 subset vs the committed
+    lockfile: exit 0, stable machine-readable schema."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import graftcheck
+    finally:
+        sys.path.pop(0)
+    rc = graftcheck.main(["--models", "MobileNetV2", "--max-batch", "8",
+                          "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["findings"] == []
+    names = set(out["programs"])
+    assert "zoo/MobileNetV2/featurize/bfloat16/b8" in names
+    assert all({"fingerprint", "flops", "findings"}
+               <= set(v) for v in out["programs"].values())
+
+
+def test_cli_missing_lockfile_exits_2(tmp_path):
+    cli = os.path.join(REPO, "tools", "graftcheck.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--lockfile", str(tmp_path / "nope.json"),
+         "--models", "MobileNetV2", "--max-batch", "8", "--no-train",
+         "--no-kernels"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "no lockfile" in r.stderr
+
+
+def test_cli_list_rules():
+    cli = os.path.join(REPO, "tools", "graftcheck.py")
+    r = subprocess.run([sys.executable, cli, "--list-rules"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for code in ("GC000", "GC001", "GC002", "GC003", "GC004", "GC005"):
+        assert code in r.stdout

@@ -463,6 +463,10 @@ def test_named_model_honors_zoo_compute_dtype(monkeypatch):
             return x
 
     monkeypatch.setattr(models, "get_model_spec", lambda n: _Spec())
+    # _resolve_model now builds the fn through named_image.zoo_model_fn
+    # (the shared constructor), which resolves the spec via named_image's
+    # own import binding
+    monkeypatch.setattr(named_image, "get_model_spec", lambda n: _Spec())
     monkeypatch.setattr(named_image, "_cached_model", lambda n: (_Mod(), {}))
     monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bfloat16")
     _, _, ov = server_mod._resolve_model("FakeZoo", None, True)

@@ -36,7 +36,9 @@ def main() -> None:
     from sparkdl_tpu.utils.metrics import Metrics
 
     fn, variables, (h, w) = bench._zoo_fn(model, featurize=True)
-    g = jax.jit(fn)
+    # no donation: the same device batch is re-dispatched every profile
+    # iteration below
+    g = jax.jit(fn, donate_argnums=())
     rng = np.random.default_rng(0)
     x = jax.device_put(
         (rng.random((batch, h, w, 3)) * 255).astype(np.uint8))

@@ -94,7 +94,8 @@ def main(argv=None) -> int:
             import numpy as np
 
             fn, (variables, batch) = __graft_entry__.entry()
-            out = jax.jit(fn)(variables, batch)
+            # no donation: one-shot smoke dispatch of caller-owned arrays
+            out = jax.jit(fn, donate_argnums=())(variables, batch)
             return {"output_shape": list(np.asarray(out).shape)}
 
         ok = _run_stage(log, "entry", run_entry) and ok

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""graftcheck — audit the stack's compiled programs against
+``PROGRAMS.lock.json`` (rules GC000–GC005; see
+``sparkdl_tpu/analysis/program``).
+
+Usage::
+
+    python tools/graftcheck.py                     # audit + verify lockfile
+    python tools/graftcheck.py --write-baseline    # regenerate lockfile
+    python tools/graftcheck.py --json              # machine-readable findings
+    python tools/graftcheck.py --models MobileNetV2 --max-batch 8
+    python tools/graftcheck.py --list-rules
+
+Chip-free by construction: the audit pins ``JAX_PLATFORMS=cpu`` and an
+8-device virtual CPU topology (the same mesh the test suite uses), and
+every program is lowered from abstract avals — no weights load, no XLA
+compile, no device memory.  The full zoo x bucket sweep runs in well
+under a minute; run-tests.sh wraps it in a wall-clock guard.
+
+Exit status: 0 clean and matching the committed lockfile; 1 findings or
+drift (each line names the GC rule); 2 usage/environment errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: the audited topology — must match tests/conftest.py's virtual mesh or
+#: fingerprints would depend on who ran the audit
+AUDIT_DEVICE_COUNT = 8
+
+# Pin the chip-free environment BEFORE jax can initialize a backend.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count"
+        f"={AUDIT_DEVICE_COUNT}").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="jaxpr/StableHLO program auditor for sparkdl_tpu")
+    ap.add_argument("--lockfile", default=None,
+                    help="lockfile path (default: repo PROGRAMS.lock.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the audited records as the new baseline "
+                         "instead of verifying against it")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output: {findings, programs}")
+    ap.add_argument("--models", default=None,
+                    help="comma list narrowing the zoo sweep (audits a "
+                         "SUBSET: missing-program drift is not checked)")
+    ap.add_argument("--max-batch", type=int, default=32,
+                    help="serving max batch; buckets are its quarter/"
+                         "half/full plan (default 32)")
+    ap.add_argument("--compute-dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"),
+                    help="audited zoo compute dtype (default bfloat16 — "
+                         "the bench/serving configuration GC002 guards)")
+    ap.add_argument("--no-train", action="store_true",
+                    help="skip the train-step programs")
+    ap.add_argument("--no-kernels", action="store_true",
+                    help="skip the sepconv kernel programs")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the GC rule table and exit")
+    args = ap.parse_args(argv)
+
+    from sparkdl_tpu.analysis.program import (GC_RULE_HELP, DEFAULT_LOCKFILE,
+                                              audit_inventory, diff_records,
+                                              read_lockfile, stack_programs,
+                                              write_lockfile)
+
+    if args.list_rules:
+        for code in sorted(GC_RULE_HELP):
+            print(f"{code}  {GC_RULE_HELP[code]}")
+        return 0
+
+    import jax
+
+    if jax.device_count() != AUDIT_DEVICE_COUNT:
+        print(f"graftcheck: {jax.device_count()} devices visible; the "
+              f"audit is fingerprinted on a {AUDIT_DEVICE_COUNT}-device "
+              f"virtual CPU topology (jax initialized before the pin?)",
+              file=sys.stderr)
+        return 2
+
+    models = ([m.strip() for m in args.models.split(",") if m.strip()]
+              if args.models else None)
+    # ANY narrowing away from the baseline configuration makes this a
+    # subset audit: the missing-program drift check would otherwise
+    # report every deliberately-skipped program as "silently left the
+    # stack"
+    subset = (bool(models) or args.no_train or args.no_kernels
+              or args.max_batch != 32 or args.compute_dtype != "bfloat16")
+    specs = stack_programs(max_batch_size=args.max_batch, models=models,
+                           compute_dtype=args.compute_dtype,
+                           include_train=not args.no_train,
+                           include_kernels=not args.no_kernels)
+
+    progress = None if args.as_json else (
+        lambda line: print(f"  {line}"))
+    if not args.as_json:
+        print(f"graftcheck: auditing {len(specs)} programs "
+              f"({args.compute_dtype}, max_batch={args.max_batch})")
+    records, findings = audit_inventory(specs, progress=progress)
+
+    path = args.lockfile or DEFAULT_LOCKFILE
+    if args.write_baseline:
+        if findings:
+            _emit(args.as_json, findings, records,
+                  "refusing to baseline a failing audit")
+            return 1
+        write_lockfile(records, path, meta={
+            "jax_version": jax.__version__,
+            "device_count": AUDIT_DEVICE_COUNT,
+            "compute_dtype": args.compute_dtype,
+            "max_batch_size": args.max_batch,
+            "generated_by": "tools/graftcheck.py --write-baseline",
+        })
+        if args.as_json:
+            print(json.dumps({"findings": [], "written": path,
+                              "programs": len(records)}))
+        else:
+            print(f"graftcheck: baseline written to {path} "
+                  f"({len(records)} programs)")
+        return 0
+
+    if not os.path.isfile(path):
+        print(f"graftcheck: no lockfile at {path}; run "
+              f"tools/graftcheck.py --write-baseline first",
+              file=sys.stderr)
+        return 2
+    committed = read_lockfile(path)
+    meta = committed.get("meta", {})
+    if meta.get("jax_version") not in (None, jax.__version__):
+        print(f"graftcheck: note — lockfile was generated under jax "
+              f"{meta.get('jax_version')}, running {jax.__version__}; "
+              f"fingerprint drift may be environmental", file=sys.stderr)
+    findings.extend(diff_records(committed, records, subset=subset))
+    _emit(args.as_json, findings, records, None)
+    return 1 if findings else 0
+
+
+def _emit(as_json: bool, findings, records, note) -> None:
+    if as_json:
+        print(json.dumps({
+            "findings": [{"rule": f.code, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+            "programs": {r["name"]: {"fingerprint": r["fingerprint"],
+                                     "flops": r["flops"],
+                                     "findings": r["findings"]}
+                         for r in records},
+        }, sort_keys=True))
+        return
+    if note:
+        print(f"graftcheck: {note}", file=sys.stderr)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"graftcheck: {len(findings)} finding(s) across "
+              f"{len({f.path for f in findings})} program(s)")
+    else:
+        print(f"graftcheck: clean ({len(records)} programs match the "
+              f"committed lockfile)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

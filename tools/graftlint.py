@@ -4,9 +4,16 @@
 Usage::
 
     python tools/graftlint.py sparkdl_tpu tools bench.py
+    python tools/graftlint.py --json sparkdl_tpu     # machine-readable
     python tools/graftlint.py --list-rules
 
 Exit status: 0 when clean, 1 when any finding survives its pragmas.
+``--json`` emits a stable machine-readable document for CI consumers::
+
+    {"findings": [{"rule": ..., "path": ..., "line": N,
+                   "message": ...}, ...],
+     "files": N, "rules": N}
+
 The run-tests.sh ``graftlint`` stage runs the first form over the whole
 stack under a 15 s wall-clock guard — the engine is stdlib-``ast`` only
 and never imports the code it analyzes, so the repo-wide run costs
@@ -38,6 +45,9 @@ def main(argv=None) -> int:
                     help="files and/or directories to lint")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings (stable schema: "
+                         "rule, path, line, message)")
     ap.add_argument("--sites-file", default=None,
                     help="explicit faults/sites.py to read the fault-site "
                          "registry from (default: auto-located under the "
@@ -67,6 +77,16 @@ def main(argv=None) -> int:
             return 2
 
     findings = lint_paths(args.targets, sites=sites)
+    if args.as_json:
+        import json
+
+        print(json.dumps({
+            "findings": [{"rule": f.code, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+            "files": len({f.path for f in findings}),
+            "rules": len(RULE_HELP),
+        }, sort_keys=True))
+        return 1 if findings else 0
     for f in findings:
         print(f.render())
     if findings:
