@@ -35,8 +35,8 @@ transfers — D2H ~1-6 MB/s, ~120 ms dispatch round trip — and the 1-vCPU
 host; PERF.md) carry a self-describing ``env_bound`` marker.
 
 Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default
-"1,1e2e,2,3,4,5,serving,pipeline" — headline first so a timed-out run
-still printed it; it is re-emitted last on completion),
+"1,1e2e,2,3,4,5,serving,fleet,pipeline" — headline first so a timed-out
+run still printed it; it is re-emitted last on completion),
 SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
 (bfloat16|float32), SPARKDL_BENCH_SERVING_REQUESTS (512),
 SPARKDL_BENCH_REPROBE_TIMEOUT (120), SPARKDL_RELAY_CACHE (last-good
@@ -57,11 +57,13 @@ each device config (mid-session recoveries salvage whatever remains;
 budgeted by SPARKDL_BENCH_MAX_REPROBES consecutive failures so a fully
 dead relay costs minutes, not the driver window), every dead-relay
 error record carries the last SUCCESSFUL probe's numbers with a
-staleness timestamp (small on-disk cache), and two configs are
-chip-independent by design: "serving" (dynamic-batching throughput + p50/p99 latency on
-a synthetic model — host orchestration + XLA compute, pinned to host
-CPU on fallback) and "pipeline" (the host/device overlap proof on a
-synthetic sleep device, always CPU).  Per-config lines that drive the
+staleness timestamp (small on-disk cache), and three configs are
+chip-independent by design: "serving" (dynamic-batching throughput +
+p50/p99 latency on a synthetic model — host orchestration + XLA
+compute, pinned to host CPU on fallback), "fleet" (the multi-tenant
+front door with a mid-run zero-downtime version swap, same fallback),
+and "pipeline" (the host/device overlap proof on a synthetic sleep
+device, always CPU).  Per-config lines that drive the
 streaming engine also carry the pipeline stage-stall ledger
 (``pipeline_stages``) so host-vs-device boundedness is visible per run.
 """
@@ -835,6 +837,106 @@ def bench_serving():
          })
 
 
+# Fleet bench child: the multi-tenant front door end-to-end (routing ->
+# tenant admission -> per-version server -> demux) with a mid-run
+# zero-downtime version swap.  Like "serving" it runs in a subprocess so
+# a dead relay falls back to host CPU — it measures the fleet envelope
+# (multiplexing, admission, swap choreography), not the accelerator.
+_FLEET_BENCH = r"""
+import json, os, time
+import numpy as np
+from sparkdl_tpu.serving import Fleet, TenantQuota
+from sparkdl_tpu.serving.errors import (QueueFullError,
+                                        ServiceUnavailableError)
+
+rng = np.random.default_rng(0)
+w1 = {"w": rng.normal(0, 0.05, (32 * 32 * 3, 64)).astype(np.float32)}
+w2 = {"w": rng.normal(0, 0.05, (32 * 32 * 3, 64)).astype(np.float32)}
+
+def fn(v, x):
+    import jax.numpy as jnp
+    xf = jnp.asarray(x, jnp.float32).reshape((x.shape[0], -1)) / 255.0
+    return jnp.tanh(xf @ v["w"])
+
+n = int(os.environ.get("SPARKDL_BENCH_FLEET_REQUESTS", "512"))
+x = (rng.random((n, 32, 32, 3)) * 255).astype(np.uint8)
+tenants = ("gold", "silver", "bronze")
+fleet = Fleet(max_batch_size=64, max_wait_ms=2.0, max_queue=n + 64,
+              quotas={"bronze": TenantQuota(rate_per_s=1e9)})
+fleet.add_model("m", fn, w1, warm_example=x[0])
+fleet.add_version("m", w2)
+t0 = time.perf_counter()
+futs, shed = [], 0
+for i in range(n):
+    if i == n // 3:  # roll the version under load
+        fleet.start_rollout("m", canary_fraction=0.25, warm_example=x[0])
+    if i == 2 * n // 3:
+        report = fleet.promote("m")
+    try:
+        futs.append(fleet.submit("m", x[i], tenant=tenants[i % 3]))
+    except (QueueFullError, ServiceUnavailableError):
+        # a loaded host can outrun the dispatcher: the submit loop hits
+        # the priority-shed pressure thresholds (or the queue bound)
+        # before the batcher drains — count it, keep measuring
+        shed += 1
+for f in futs:
+    f.result()
+elapsed = time.perf_counter() - t0
+m = fleet.metrics
+from sparkdl_tpu.obs.export import metrics_snapshot
+out = {
+    "ips": len(futs) / elapsed,
+    "p50_ms": 1e3 * m.percentile("fleet.request_latency", 50),
+    "p99_ms": 1e3 * m.percentile("fleet.request_latency", 99),
+    "num_requests": len(futs),
+    "shed": shed,
+    "swap_no_recompile": bool(report["no_recompile"]),
+    "canary_requests": int(m.counters.get("fleet.canary_requests", 0)),
+    "final_version": fleet.deployed_version("m"),
+    "metrics_snapshot": metrics_snapshot(m),
+}
+fleet.close()
+print(json.dumps(out))
+"""
+
+
+def bench_fleet():
+    """Multi-tenant fleet front door: mixed-tenant throughput + p50/p99
+    with a zero-downtime version swap mid-run; the line also records the
+    swap's no-recompile verdict.  CPU fallback like "serving" — the
+    fleet layer is host orchestration over the same engine."""
+    cpu_fallback = bool(_RELAY_DEAD[0])
+    env = dict(os.environ)
+    if cpu_fallback:
+        env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_FLEET_BENCH, timeout_s=480, env=env)
+    if cpu_fallback:
+        bound = ("cpu-fallback: relay unreachable at bench start; fleet "
+                 "stack (routing/admission/swap/dispatch) exercised "
+                 "end-to-end on host CPU")
+    else:
+        bound = _relay_tag() + "-per-batch+1vcpu-host"
+    emit("fleet",
+         "multi-tenant fleet serving with mid-run version hot-swap "
+         "(synthetic models)",
+         prof["ips"], "images/sec",
+         env_bound=bound,
+         extra={
+             "p50_ms": round(float(prof["p50_ms"]), 2),
+             "p99_ms": round(float(prof["p99_ms"]), 2),
+             "num_requests": prof["num_requests"],
+             "swap_no_recompile": prof["swap_no_recompile"],
+             "canary_requests": prof["canary_requests"],
+             "final_version": prof["final_version"],
+             # the CHILD's registry (see bench_serving)
+             **({"metrics_snapshot": prof["metrics_snapshot"]}
+                if prof.get("metrics_snapshot") else {}),
+         })
+
+
 # Synthetic-device pipeline bench child: the overlap proof without the
 # chip.  Always pinned to host CPU — the "device" is a deterministic
 # sleep standing in for the relay's blocking ~100 ms dispatch round trip
@@ -891,14 +993,16 @@ BENCHES = {
     "4": bench_config4,
     "5": bench_config5,
     "serving": bench_serving,
+    "fleet": bench_fleet,
     "pipeline": bench_pipeline,
 }
 
 
-# Configs that never need the chip: "serving" runs on its CPU fallback
-# (it measures the serving envelope — queue/batching/dispatch) and
-# "pipeline" simulates its device with a deterministic sleep.
-_CHIPLESS_CONFIGS = ("serving", "pipeline")
+# Configs that never need the chip: "serving" and "fleet" run on their
+# CPU fallback (they measure the serving/fleet envelopes —
+# queue/batching/admission/swap/dispatch) and "pipeline" simulates its
+# device with a deterministic sleep.
+_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -946,7 +1050,7 @@ def main():
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
-    default = "1,1e2e,2,3,4,5,serving,pipeline"
+    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline"
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

@@ -42,6 +42,12 @@ if [[ ! -f tests/test_faults.py ]]; then
        "would ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_fleet.py ]]; then
+  echo "FATAL: tests/test_fleet.py missing — the fleet subsystem" \
+       "(registry, zero-downtime rollout, tenant admission, chaos" \
+       "swap test) would ship untested" >&2
+  exit 1
+fi
 if [[ ! -f tests/test_analysis.py ]]; then
   echo "FATAL: tests/test_analysis.py missing — the graftlint rules and" \
        "lock-order checker would ship untested" >&2
@@ -94,6 +100,25 @@ echo "== fault-injection suite (SPARKDL_FAULTS active) =="
 SPARKDL_FAULTS="seed=1;engine.dispatch:sleep:ms=1,times=3" \
   SPARKDL_LOCKCHECK=1 \
   python -m pytest tests/test_faults.py -q -k "not sigkill"
+
+# Fleet stage (ISSUE 7 satellite): re-run the fleet suite — headline
+# chaos rollout included — with SPARKDL_FAULTS exported so the env gate
+# carries real fleet.* rules (the tests install their own plans over
+# it), and with SPARKDL_LOCKCHECK=1 so the four new fleet locks
+# (registry/state/admission/rollout) feed the lock-order graph under
+# injected swap/canary/admission schedules.  Wall-guarded: the suite
+# runs in ~10 s; 300 s covers loaded CI hosts.
+echo "== fleet serving suite (SPARKDL_FAULTS active) =="
+SPARKDL_FAULTS="seed=2;fleet.canary:sleep:ms=1,times=2" \
+  SPARKDL_LOCKCHECK=1 \
+  timeout -k 10 300 python -m pytest tests/test_fleet.py -q
+# graftlint self-check scoped to the new package (named locks only,
+# SDL001-SDL007 clean, no pragmas): the whole-stack pass above already
+# covers it, but a scoped run pins the fleet package's own cleanliness
+# even if the wide target list ever changes.
+echo "== graftlint fleet package self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/serving/fleet \
+  --sites-file sparkdl_tpu/faults/sites.py
 
 # Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device
 # benchmark must show that (a) DISABLED tracing (SPARKDL_TRACE=0) adds

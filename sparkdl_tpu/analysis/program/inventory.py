@@ -161,6 +161,30 @@ def zoo_dispatch_specs(max_batch_size: int = 32,
     return specs
 
 
+def fleet_dispatch_specs(models: Optional[Sequence[str]] = None,
+                         max_batch_size: int = 32,
+                         compute_dtype: str = "bfloat16",
+                         mesh=None) -> List[ProgramSpec]:
+    """Every program a ``serving.fleet.Fleet`` can construct for its
+    zoo-backed entries — the fleet enumeration hook graftcheck audits.
+
+    BY CONSTRUCTION this is the existing zoo × serving-bucket-plan
+    program set, nothing more: a fleet entry resolves its fn exactly
+    once through ``named_image.zoo_serving_bundle`` (→ ``zoo_model_fn``,
+    the same constructor :func:`zoo_dispatch_specs` lowers), every
+    version of the entry reuses that one fn object with new WEIGHTS
+    only, and each version's ``Server`` compiles through the same
+    ``bucket_plan`` × ``build_dispatch_jit`` path.  New versions and
+    hot-swaps therefore add NO programs to the inventory —
+    ``PROGRAMS.lock.json`` regenerates only if the underlying zoo ×
+    bucket set itself changes (tests pin the set equality and match the
+    audited executable keys/fingerprints against the committed
+    lockfile)."""
+    return zoo_dispatch_specs(max_batch_size=max_batch_size,
+                              models=models, compute_dtype=compute_dtype,
+                              mesh=mesh)
+
+
 def train_step_specs(batch_rows: int = 32, feature_dim: int = 2048,
                      num_classes: int = 10, mesh=None) -> List[ProgramSpec]:
     """The data-parallel train-step programs the estimator layer

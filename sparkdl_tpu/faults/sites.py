@@ -28,6 +28,9 @@ SITE_HELP = {
     "pipeline.gather": "PipelinedRunner gather stage loop",
     "serving.admit": "DynamicBatcher.submit admission",
     "serving.model": "Server model-call attempt (watchdog-timed)",
+    "fleet.admit": "Fleet front-door admission (tenant quota/priority gate)",
+    "fleet.canary": "Fleet canary routing decision during a rollout",
+    "fleet.swap": "Fleet version swap attempt (rollout promote/rollback)",
     "probe.device": "__graft_entry__ device-count relay probe",
     "bench.relay_probe": "bench.py relay profile probe",
     "io.decode": "host image decode, per row",

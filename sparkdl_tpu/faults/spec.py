@@ -70,13 +70,15 @@ class FaultRule:
             raise ValueError(
                 f"unknown exc kind {exc!r} (site {self.site}); known: "
                 f"{', '.join(EXC_KINDS)}")
-        if exc == "queue_full" and not self.site.startswith("serving."):
+        if exc == "queue_full" and not self.site.startswith(("serving.",
+                                                             "fleet.")):
             # QueueFullError is not an InjectedFault: outside the serving
-            # layer it would escape every `except InjectedFault` site
-            # handler and crash the host path instead of testing it
+            # and fleet admission layers it would escape every `except
+            # InjectedFault` site handler and crash the host path
+            # instead of testing it
             raise ValueError(
-                f"exc=queue_full is only meaningful at serving.* sites, "
-                f"not {self.site!r}")
+                f"exc=queue_full is only meaningful at serving.*/fleet.* "
+                f"sites, not {self.site!r}")
         for k in self.params:
             if k != "exc" and k not in _INT_PARAMS + _FLOAT_PARAMS:
                 raise ValueError(

@@ -149,9 +149,13 @@ def metrics_snapshot(metrics: Metrics) -> Dict[str, Any]:
     lines and ``Server.varz`` embed this shape, and drivers diff it
     across rounds."""
     raw = metrics.snapshot_raw()
+    # float() everywhere: the recorders already coerce, but the snapshot
+    # is the JSON boundary (varz endpoint bodies, bench lines) — and
+    # round(np.float64) hands back a numpy scalar json.dumps rejects, so
+    # nothing numpy may survive past here
     out: Dict[str, Any] = {
-        "counters": raw["counters"],
-        "gauges": raw["gauges"],
+        "counters": {k: float(v) for k, v in raw["counters"].items()},
+        "gauges": {k: float(v) for k, v in raw["gauges"].items()},
         "timings_s": {},
         "histograms": {},
     }
@@ -160,19 +164,19 @@ def metrics_snapshot(metrics: Metrics) -> Dict[str, Any]:
             continue
         out["timings_s"][name] = {
             "count": len(series),
-            "total_s": round(sum(series), 6),
-            "mean_s": round(sum(series) / len(series), 6),
-            "p50_s": round(Metrics._percentile(series, 50), 6),
-            "p99_s": round(Metrics._percentile(series, 99), 6),
+            "total_s": float(round(sum(series), 6)),
+            "mean_s": float(round(sum(series) / len(series), 6)),
+            "p50_s": float(round(Metrics._percentile(series, 50), 6)),
+            "p99_s": float(round(Metrics._percentile(series, 99), 6)),
         }
     for name, series in raw["histograms"].items():
         if not series:
             continue
         out["histograms"][name] = {
             "count": len(series),
-            "mean": round(sum(series) / len(series), 6),
-            "p50": round(Metrics._percentile(series, 50), 6),
-            "p99": round(Metrics._percentile(series, 99), 6),
+            "mean": float(round(sum(series) / len(series), 6)),
+            "p50": float(round(Metrics._percentile(series, 50), 6)),
+            "p99": float(round(Metrics._percentile(series, 99), 6)),
         }
     return out
 

@@ -24,15 +24,24 @@ Public surface:
 * ``Server.health()`` — live/ready/degraded with last error, per-bucket
   circuit-breaker state, and a bounded transition history (also under
   ``varz()["health"]``); README "Failure model" documents the states.
+* :class:`Fleet` (``sparkdl_tpu.serving.fleet``) — the multi-model,
+  multi-tenant front door: named versioned registry entries,
+  zero-downtime canary rollout with no-recompile hot-swap, per-tenant
+  token-bucket quotas + priority classes (:class:`TenantQuota`,
+  :class:`QuotaExceededError`), aggregated ``Fleet.varz()``/``health()``.
 """
 
 from sparkdl_tpu.serving.adapters import from_transformer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
 from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError, QueueFullError,
-                                        ServerClosedError,
+                                        QuotaExceededError, ServerClosedError,
                                         ServiceUnavailableError, ServingError)
 from sparkdl_tpu.serving.server import Server, bucket_plan
+# the fleet package imports serving.server/serving.errors, so it must
+# come last here
+from sparkdl_tpu.serving.fleet import (Fleet, ModelRegistry, ModelVersion,
+                                       Rollout, TenantQuota)
 
 __all__ = [
     "Server",
@@ -40,8 +49,14 @@ __all__ = [
     "from_transformer",
     "DynamicBatcher",
     "Request",
+    "Fleet",
+    "ModelRegistry",
+    "ModelVersion",
+    "Rollout",
+    "TenantQuota",
     "ServingError",
     "QueueFullError",
+    "QuotaExceededError",
     "DeadlineExceededError",
     "DispatchTimeoutError",
     "ServiceUnavailableError",

@@ -42,6 +42,21 @@ class ServiceUnavailableError(ServingError):
         self.retry_after_s = float(retry_after_s)
 
 
+class QuotaExceededError(QueueFullError):
+    """Fleet admission rejected THIS TENANT: its token-bucket rate quota
+    is exhausted or its in-flight cap is reached (other tenants are
+    unaffected — that is the point of per-tenant admission).  Subclasses
+    :class:`QueueFullError` so existing retry-later client handling
+    keeps working; ``retry_after_s`` is the token-refill estimate (capped
+    — a zero-quota tenant is never admitted and gets the cap).
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 tenant: str = ""):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.tenant = tenant
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline expired while it waited in the queue; it was
     shed before dispatch (no device work was spent on it)."""

@@ -1,0 +1,506 @@
+"""The fleet front door: many named, versioned models behind one API.
+
+Where :class:`~sparkdl_tpu.serving.server.Server` fronts exactly ONE
+model for one anonymous caller, a :class:`Fleet` multiplexes many
+registry entries over shared TPU capacity with per-tenant admission
+(:mod:`.admission`), zero-downtime version rollouts (:mod:`.rollout`),
+and aggregated health/metrics:
+
+::
+
+    with Fleet(max_batch_size=32, max_wait_ms=3) as fleet:
+        fleet.add_model("feats", "InceptionV3", featurize=True)
+        fleet.add_model("clf", my_fn, variables_v1)
+        y = fleet.predict("clf", row, tenant="team-a")
+
+        fleet.add_version("clf", variables_v2)       # register v2
+        ro = fleet.start_rollout("clf", canary_fraction=0.1)
+        ...                                          # watch varz()
+        fleet.promote("clf")                         # or rollback("clf")
+
+Request path: route (stable vs canary, deterministic fraction) →
+admission gate (tenant token bucket / in-flight cap / priority shed
+against the TARGET server's queue pressure and breaker) → the version's
+own ``Server`` (dynamic batching, buckets, deadlines, watchdog,
+breaker).  The returned future carries ``fleet_model`` /
+``fleet_version`` / ``fleet_tenant`` / ``fleet_canary`` attributes so
+callers (and the chaos test) can hold results to the right oracle.
+Request spans (``fleet.request``) tag model, version, and tenant, and
+the per-version server spans nest under them.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.faults import inject
+from sparkdl_tpu.obs.trace import get_tracer
+from sparkdl_tpu.serving.errors import ServerClosedError
+from sparkdl_tpu.serving.fleet.admission import (AdmissionController,
+                                                 TenantQuota)
+from sparkdl_tpu.serving.fleet.registry import ModelRegistry, ModelVersion
+from sparkdl_tpu.serving.fleet.rollout import Rollout
+from sparkdl_tpu.serving.server import Server
+from sparkdl_tpu.utils.logging import get_logger
+from sparkdl_tpu.utils.metrics import Metrics
+
+logger = get_logger(__name__)
+
+
+class _ModelState:
+    """One deployed entry: its live server, version, and rollout."""
+
+    __slots__ = ("entry", "version", "server", "rollout",
+                 "last_swap_report", "server_kwargs")
+
+    def __init__(self, entry, version: int, server: Server,
+                 server_kwargs: Dict[str, Any]):
+        self.entry = entry
+        self.version = version
+        self.server = server
+        self.rollout: Optional[Rollout] = None
+        self.last_swap_report: Optional[Dict[str, Any]] = None
+        self.server_kwargs = dict(server_kwargs)
+
+
+class Fleet:
+    """Multi-tenant, versioned model-fleet serving with zero-downtime
+    hot-swap.  Constructor kwargs beyond the admission knobs are the
+    DEFAULT per-version :class:`Server` configuration
+    (``max_batch_size``, ``max_wait_ms``, ``max_queue``, buckets,
+    breaker knobs, ...); ``add_model`` kwargs override them per entry.
+    """
+
+    def __init__(self, *,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 default_quota: Optional[TenantQuota] = None,
+                 shed_pressure: Optional[Dict[int, float]] = None,
+                 metrics: Optional[Metrics] = None,
+                 **server_defaults):
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.registry = ModelRegistry()
+        self.admission = AdmissionController(
+            quotas=quotas, default_quota=default_quota,
+            shed_pressure=shed_pressure)
+        self._server_defaults = dict(server_defaults)
+        self._lock = named_lock("fleet.state")
+        self._models: Dict[str, _ModelState] = {}
+        self._closed = False
+        #: per-model / per-tenant request ledgers (varz sections); plain
+        #: dicts mutated only under self._lock
+        self._per_model: Dict[str, Dict[str, int]] = {}
+        self._per_tenant: Dict[str, Dict[str, int]] = {}
+
+    # -- deployment --------------------------------------------------------
+    def add_model(self, name: str, model: Any, variables: Any = None, *,
+                  featurize: bool = False, label: Optional[str] = None,
+                  warm_example: Any = None,
+                  **server_kwargs) -> ModelVersion:
+        """Register entry ``name`` (v1) and deploy it immediately.
+        ``server_kwargs`` become this entry's Server configuration (on
+        top of the fleet defaults) for v1 and every later version."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("fleet is closed")
+            if name in self._models:
+                raise ValueError(
+                    f"model {name!r} already deployed; use add_version() "
+                    f"+ start_rollout() to ship new weights")
+        mv = self.registry.register(name, model, variables,
+                                    featurize=featurize, label=label)
+        entry = self.registry.entry(name)
+        server = None
+        try:
+            server = self._build_server(entry, mv, server_kwargs)
+            if warm_example is not None:
+                server.warmup(warm_example)
+            state = _ModelState(entry, mv.version, server, server_kwargs)
+            with self._lock:
+                # re-check BOTH refusals: a close() or a racing
+                # add_model of the same name may have landed during the
+                # (slow, outside-lock) server build — inserting now
+                # would leak a live dispatcher thread no close() will
+                # ever stop, or silently replace the racer's state
+                closed = self._closed
+                dup = name in self._models
+                if not closed and not dup:
+                    self._models[name] = state
+            if dup:
+                raise ValueError(
+                    f"model {name!r} already deployed; use add_version() "
+                    f"+ start_rollout() to ship new weights")
+            if closed:
+                raise ServerClosedError("fleet is closed")
+        except BaseException:  # noqa: BLE001 — cleaned up, re-raised
+            # a failed deploy must leave nothing behind: no live
+            # dispatcher thread, and no catalog entry poisoning the
+            # name for a retry
+            if server is not None:
+                server.close(drain=False)
+            self.registry.discard(name, mv.version)
+            raise
+        logger.info("fleet: deployed %s v%d", name, mv.version)
+        return mv
+
+    def add_version(self, name: str, variables: Any = None, *,
+                    label: Optional[str] = None) -> ModelVersion:
+        """Register the next version's weights for entry ``name``.  The
+        version is CATALOG-only until a rollout deploys it."""
+        return self.registry.register(name, variables=variables,
+                                      label=label)
+
+    def _build_server(self, entry, mv: ModelVersion,
+                      server_kwargs: Dict[str, Any]) -> Server:
+        kw = dict(self._server_defaults)
+        kw.update(server_kwargs)
+        # the entry's resolved dtype contract (e.g. the zoo bf16 compute
+        # + f32 host cast) applies unless the caller set the knobs
+        if ("compute_dtype" not in kw and "output_host_dtype" not in kw):
+            kw.update(entry.engine_overrides)
+        return Server(entry.fn, variables=mv.variables, **kw)
+
+    # -- rollout lifecycle -------------------------------------------------
+    def _state(self, name: str) -> _ModelState:
+        with self._lock:
+            state = self._models.get(name)
+        if state is None:
+            raise KeyError(f"model {name!r} is not deployed; deployed: "
+                           f"{sorted(self._models) or 'none'}")
+        return state
+
+    def start_rollout(self, name: str, version: Optional[int] = None,
+                      canary_fraction: float = 0.1,
+                      warm_example: Any = None) -> Rollout:
+        """Load ``version`` (default: latest registered) ALONGSIDE the
+        live version and start routing ``canary_fraction`` of traffic to
+        it.  Both versions serve until :meth:`promote` or
+        :meth:`rollback`; in-flight requests always complete on the
+        version that admitted them."""
+        if not 0.0 <= float(canary_fraction) <= 1.0:
+            # validate BEFORE building the canary server: a refused
+            # rollout must not leak a live dispatcher thread
+            raise ValueError(f"canary fraction must be in [0, 1], got "
+                             f"{canary_fraction}")
+        state = self._state(name)
+        with self._lock:
+            if state.rollout is not None:
+                raise RuntimeError(
+                    f"a rollout for {name!r} is already in progress "
+                    f"(v{state.rollout.canary_version}); promote or "
+                    f"roll back first")
+        mv = self.registry.get(name, version)
+        if mv.version == state.version:
+            raise ValueError(f"{name!r} is already serving v{mv.version}")
+        canary = self._build_server(state.entry, mv, state.server_kwargs)
+        if warm_example is not None:
+            try:
+                canary.warmup(warm_example)
+            except BaseException:  # noqa: BLE001 — cleaned up, re-raised
+                # a refused rollout must not leak a live dispatcher
+                # thread; the version stays cataloged (it never deployed)
+                canary.close(drain=False)
+                raise
+        ro = Rollout(name, state.version, state.server, mv.version, canary,
+                     canary_fraction,
+                     exec_before=state.server.executable_state())
+        with self._lock:
+            if state.rollout is not None or self._closed:
+                already = state.rollout is not None
+                state_err = ("rollout already in progress" if already
+                             else "fleet is closed")
+            else:
+                state_err = None
+                state.rollout = ro
+        if state_err is not None:
+            canary.close(drain=False)
+            raise RuntimeError(f"cannot start rollout for {name!r}: "
+                               f"{state_err}")
+        self.metrics.incr("fleet.rollouts")
+        logger.info("fleet: rollout %s v%d -> v%d (canary %.0f%%)",
+                    name, state.version, mv.version,
+                    100 * canary_fraction)
+        return ro
+
+    def promote(self, name: str) -> Dict[str, Any]:
+        """Flip ``name`` to its canary version and drain the old one.
+        Returns the swap report (per-bucket no-recompile proof).  An
+        injected ``fleet.swap`` fault aborts BEFORE any state changes —
+        both versions keep serving and promote() can be retried."""
+        state = self._state(name)
+        ro = state.rollout
+        if ro is None:
+            raise RuntimeError(f"no rollout in progress for {name!r}")
+        report = ro.promote()  # fleet.swap fires here; raises = no-op
+        with self._lock:
+            old_server = state.server
+            state.server = ro.canary_server
+            state.version = ro.canary_version
+            state.rollout = None
+            state.last_swap_report = report
+            closed = self._closed
+        self.metrics.incr("fleet.swaps")
+        # the old version drains OUTSIDE the state lock: new requests
+        # already route to the promoted server while every in-flight v1
+        # request completes on v1
+        old_server.close(drain=True)
+        if closed:
+            # a close() that raced the phase flip saw ro.active False,
+            # skipped the canary, and closed only the old server — the
+            # canary is the live server of a closed fleet now; stop it
+            ro.canary_server.close(drain=True)
+        return report
+
+    def rollback(self, name: str) -> Dict[str, Any]:
+        """Abandon ``name``'s canary: requests in flight on it complete
+        on the canary version (graceful drain); the stable version never
+        stopped serving."""
+        state = self._state(name)
+        ro = state.rollout
+        if ro is None:
+            raise RuntimeError(f"no rollout in progress for {name!r}")
+        report = ro.rollback()  # fleet.swap fires here; raises = no-op
+        with self._lock:
+            state.rollout = None
+            state.last_swap_report = report
+        self.metrics.incr("fleet.rollbacks")
+        ro.canary_server.close(drain=True)
+        return report
+
+    def swap_report(self, name: str) -> Optional[Dict[str, Any]]:
+        """The last promote/rollback report for ``name`` (None before
+        the first swap)."""
+        state = self._state(name)
+        with self._lock:
+            return state.last_swap_report
+
+    # -- request path ------------------------------------------------------
+    def submit(self, name: str, example: Any, *, tenant: str = "default",
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one example for model ``name`` on behalf of ``tenant``.
+
+        Raises ``KeyError`` (unknown model), ``ServerClosedError``
+        (closed fleet), ``QuotaExceededError`` / ``QueueFullError`` /
+        ``ServiceUnavailableError`` (admission — see :mod:`.admission`).
+        The returned future settles exactly like ``Server.submit``'s and
+        additionally carries ``fleet_model``/``fleet_version``/
+        ``fleet_tenant``/``fleet_canary`` attributes."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("fleet is closed")
+        state = self._state(name)
+        self.metrics.incr("fleet.requests")
+        inject("fleet.admit")
+        # a promote/rollback between route() and the server submit can
+        # close the losing server under us; one re-route retries onto
+        # the winner — the zero-downtime guarantee for the racing window
+        for attempt in (0, 1):
+            version, server, is_canary = self._route(state)
+            quota = self.admission.admit(
+                tenant, pressure=server.queue_pressure(),
+                unavailable_retry_after=server.breaker_retry_after())
+            t0 = time.monotonic()
+            tracer = get_tracer()
+            span = tracer.start_span("fleet.request", model=name,
+                                     version=version, tenant=tenant,
+                                     canary=is_canary,
+                                     priority=quota.priority)
+            try:
+                with tracer.use(span):
+                    fut = server.submit(example, timeout_ms=timeout_ms)
+                break
+            except ServerClosedError:
+                span.finish("rejected")
+                # the request never reached a live server: refund the
+                # charge (slot AND token, admitted ledger backed out) —
+                # whether we retry or reject, it must not cost quota
+                self.admission.refund(tenant)
+                with self._lock:
+                    fleet_closed = self._closed
+                if attempt == 0 and not fleet_closed:
+                    continue  # re-route: the swap already installed v2
+                self.metrics.incr("fleet.rejected")
+                self._count(name, tenant, "rejected")
+                raise
+            except BaseException:  # noqa: BLE001 — accounted, re-raised
+                self.admission.release(tenant)
+                span.finish("rejected")
+                self.metrics.incr("fleet.rejected")
+                self._count(name, tenant, "rejected")
+                raise
+        self._count(name, tenant, "requests")
+        if is_canary:
+            self.metrics.incr("fleet.canary_requests")
+            self._count(name, tenant, "canary")
+        fut.fleet_model = name
+        fut.fleet_version = version
+        fut.fleet_tenant = tenant
+        fut.fleet_canary = is_canary
+
+        def _settle(f: Future) -> None:
+            self.admission.release(tenant)
+            failed = f.cancelled() or f.exception() is not None
+            self.metrics.record_time("fleet.request_latency",
+                                     time.monotonic() - t0)
+            if failed:
+                self.metrics.incr("fleet.request_failures")
+                self._count(name, tenant, "failed")
+                span.finish("error")
+            else:
+                self.metrics.incr("fleet.completed")
+                self._count(name, tenant, "completed")
+                span.finish()
+
+        fut.add_done_callback(_settle)
+        return fut
+
+    def predict(self, name: str, example: Any, *, tenant: str = "default",
+                timeout_ms: Optional[float] = None) -> Any:
+        """Blocking single-request convenience: submit + wait."""
+        return self.submit(name, example, tenant=tenant,
+                           timeout_ms=timeout_ms).result()
+
+    def _route(self, state: _ModelState):
+        with self._lock:
+            ro = state.rollout
+            version, server = state.version, state.server
+        if ro is not None:
+            return ro.route()
+        return version, server, False
+
+    def _count(self, model: str, tenant: str, key: str) -> None:
+        with self._lock:
+            m = self._per_model.setdefault(model, {})
+            m[key] = m.get(key, 0) + 1
+            t = self._per_tenant.setdefault(tenant, {})
+            t[key] = t.get(key, 0) + 1
+
+    # -- introspection -----------------------------------------------------
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def deployed_version(self, name: str) -> int:
+        state = self._state(name)
+        with self._lock:
+            return state.version
+
+    def health(self) -> Dict[str, Any]:
+        """Aggregated liveness/readiness: fleet state is the WORST of
+        its models' server states (plus canary servers mid-rollout);
+        per-model detail nests each server's own ``health()``."""
+        with self._lock:
+            models = dict(self._models)
+            closed = self._closed
+        rank = {"ready": 0, "degraded": 1, "closed": 1}
+        worst = "ready"
+        per: Dict[str, Any] = {}
+        for name, state in sorted(models.items()):
+            h = state.server.health()
+            entry: Dict[str, Any] = {"version": state.version,
+                                     "stable": h}
+            ro = state.rollout
+            if ro is not None and ro.active:
+                ch = ro.canary_server.health()
+                entry["canary"] = {"version": ro.canary_version,
+                                   "health": ch}
+                if rank.get(ch["state"], 1) > rank[worst]:
+                    worst = "degraded"
+            per[name] = entry
+            if rank.get(h["state"], 1) > rank[worst]:
+                worst = "degraded"
+        return {
+            "live": not closed,
+            "state": "closed" if closed else worst,
+            "models": per,
+        }
+
+    def stats(self) -> Dict[str, float]:
+        """Flat fleet-level metrics summary (``fleet.*``)."""
+        return self.metrics.subset("fleet.")
+
+    def varz(self) -> Dict[str, Any]:
+        """The ``/varz``-shaped fleet snapshot: per-model versions,
+        rollout state, queue/bucket/executable state, and latency; the
+        admission ledger; per-tenant counts; fleet counters and the full
+        metrics snapshot.  JSON-serializable throughout —
+        ``json.dumps(fleet.varz())`` IS the monitoring endpoint body
+        (contract-tested, like ``Server.varz``)."""
+        from sparkdl_tpu.obs.export import metrics_snapshot
+
+        with self._lock:
+            models = dict(self._models)
+            closed = self._closed
+            per_model = {k: dict(v) for k, v in self._per_model.items()}
+            per_tenant = {k: dict(v) for k, v in self._per_tenant.items()}
+        model_section: Dict[str, Any] = {}
+        for name, state in sorted(models.items()):
+            srv = state.server
+            ro = state.rollout
+
+            def dist_ms(m: Metrics, metric: str) -> Dict[str, float]:
+                out: Dict[str, float] = {}
+                for q, key in ((50, "p50_ms"), (99, "p99_ms")):
+                    v = m.percentile(metric, q, kind="timing")
+                    if v is not None:
+                        out[key] = round(v * 1e3, 3)
+                return out
+
+            model_section[name] = {
+                "version": state.version,
+                "versions": self.registry.versions(name),
+                "featurize": state.entry.featurize,
+                "model": state.entry.model_desc,
+                "queue_depth": srv.queue_depth(),
+                "queue_pressure": round(srv.queue_pressure(), 4),
+                "buckets": srv.bucket_sizes,
+                "executables": srv.executable_state(),
+                "rollout": ro.status() if ro is not None else None,
+                "last_swap": state.last_swap_report,
+                "counters": per_model.get(name, {}),
+                "latency_ms": dist_ms(srv.metrics,
+                                      "serving.request_latency"),
+            }
+        snap = metrics_snapshot(self.metrics)
+        return {
+            "fleet": {
+                "closed": closed,
+                "models": model_section,
+                "registry": self.registry.as_dict(),
+            },
+            "health": self.health(),
+            "admission": self.admission.snapshot(),
+            "tenants": per_tenant,
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("fleet.")},
+            "metrics": snap,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the whole fleet: every model's server (and any live
+        canary) closes with the given drain semantics.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            models = dict(self._models)
+        for name, state in sorted(models.items()):
+            ro = state.rollout
+            if ro is not None and ro.active:
+                ro.canary_server.close(drain=drain)
+            state.server.close(drain=drain)
+        logger.info("fleet: closed (%d models)", len(models))
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)

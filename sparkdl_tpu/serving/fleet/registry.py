@@ -1,0 +1,216 @@
+"""Named, versioned model registry — the fleet's catalog.
+
+An ENTRY is a named model slot (``"image-featurizer"``): the model form
+is resolved exactly once when the entry is created, through the same
+``serving.server._resolve_model`` path every :class:`~sparkdl_tpu.
+serving.server.Server` uses — a zoo model NAME routes through
+``transformers.named_image.zoo_serving_bundle`` (→ ``zoo_model_fn``, so
+served == transformed == audited stays true by construction), a
+``ModelFunction`` or raw callable is taken as-is.  The resolved ``fn``
+object is pinned on the entry and shared by every version.
+
+A VERSION is that fn plus one concrete weight pytree, numbered
+monotonically per entry (v1, v2, ...).  Because every version reuses the
+entry's ONE fn object, the engine layer's module-level jit cache (keyed
+on ``id(fn)``) hands v2's engines the very jit program v1 compiled:
+identical shapes/dtypes mean identical executable cache keys, so a
+hot-swap performs no recompilation — the property
+``serving.fleet.rollout`` asserts at promote time and
+``analysis.program``'s fleet enumeration hook pins against
+``PROGRAMS.lock.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ModelVersion:
+    """One immutable (entry, version number, weights) triple."""
+
+    __slots__ = ("name", "version", "variables", "label")
+
+    def __init__(self, name: str, version: int, variables: Any,
+                 label: Optional[str] = None):
+        self.name = name
+        self.version = int(version)
+        self.variables = variables
+        self.label = label
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-serializable summary (no weights)."""
+        return {"name": self.name, "version": self.version,
+                "label": self.label}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        return f"ModelVersion({self.name!r}, v{self.version})"
+
+
+class FleetEntry:
+    """A named model slot: the ONE resolved fn + its versions."""
+
+    __slots__ = ("name", "featurize", "fn", "default_variables",
+                 "engine_overrides", "model_desc", "versions",
+                 "_next_version")
+
+    def __init__(self, name: str, fn, default_variables: Any,
+                 engine_overrides: Dict[str, Any], featurize: bool,
+                 model_desc: str):
+        self.name = name
+        self.featurize = bool(featurize)
+        self.fn = fn
+        self.default_variables = default_variables
+        self.engine_overrides = dict(engine_overrides)
+        self.model_desc = model_desc
+        self.versions: Dict[int, ModelVersion] = {}
+        self._next_version = 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "model": self.model_desc,
+            "featurize": self.featurize,
+            "versions": sorted(self.versions),
+        }
+
+
+class ModelRegistry:
+    """Thread-safe name → :class:`FleetEntry` catalog with monotonically
+    numbered versions.
+
+    ::
+
+        reg = ModelRegistry()
+        v1 = reg.register("clf", fn, variables_v1)    # entry + v1
+        v2 = reg.register("clf", variables=variables_v2)  # same fn, v2
+
+    Re-registering an existing entry with a NEW model form is refused:
+    versions are weights-only by design — a different fn would silently
+    fork the compiled-program identity and defeat the no-recompile
+    hot-swap guarantee.
+    """
+
+    def __init__(self):
+        self._entries: Dict[str, FleetEntry] = {}
+        self._lock = named_lock("fleet.registry")
+
+    def register(self, name: str, model: Any = None, variables: Any = None,
+                 *, featurize: bool = False,
+                 label: Optional[str] = None) -> ModelVersion:
+        """Create entry ``name`` (first call: ``model`` required) and/or
+        append its next :class:`ModelVersion` holding ``variables``
+        (default: the entry's resolved weights — e.g. the zoo weights
+        for a named zoo entry)."""
+        if not name or not isinstance(name, str):
+            raise ValueError(f"model name must be a non-empty string, "
+                             f"got {name!r}")
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            if model is None:
+                raise ValueError(
+                    f"unknown model entry {name!r}: the first register() "
+                    f"must pass the model (zoo name, ModelFunction, or "
+                    f"fn(variables, batch))")
+            from sparkdl_tpu.graph.function import ModelFunction
+            from sparkdl_tpu.serving.server import _resolve_model
+
+            # plain callables take their weights here; zoo names and
+            # ModelFunctions carry their own (and _resolve_model refuses
+            # explicit variables for them)
+            resolve_vars = (variables if callable(model)
+                            and not isinstance(model, ModelFunction)
+                            else None)
+            fn, default_vars, overrides = _resolve_model(
+                model, resolve_vars, featurize)
+            desc = (model if isinstance(model, str)
+                    else type(model).__name__)
+            entry = FleetEntry(name, fn, default_vars, overrides,
+                               featurize, desc)
+            with self._lock:
+                if name in self._entries:  # lost a racing register
+                    existing = self._entries[name]
+                    if existing.fn is not entry.fn:
+                        # adopting the winner would catalog OUR weights
+                        # under THEIR fn — refuse, like re-register
+                        raise ValueError(
+                            f"entry {name!r} was concurrently registered "
+                            f"with a different model fn; versions carry "
+                            f"new WEIGHTS only")
+                    entry = existing
+                else:
+                    self._entries[name] = entry
+        elif model is not None:
+            raise ValueError(
+                f"entry {name!r} already exists; versions carry new "
+                f"WEIGHTS only (pass variables=...) — a new model fn "
+                f"would fork the compiled program and break the "
+                f"no-recompile hot-swap contract")
+        with self._lock:
+            v = entry._next_version
+            entry._next_version = v + 1
+            mv = ModelVersion(
+                name, v,
+                entry.default_variables if variables is None else variables,
+                label=label)
+            entry.versions[v] = mv
+        logger.info("registered %s v%d%s", name, v,
+                    f" ({label})" if label else "")
+        return mv
+
+    def discard(self, name: str, version: int) -> None:
+        """Back out a version that never deployed (the fleet's
+        failed-deploy cleanup path); the entry goes with its last
+        version, so the name is reusable after a failed first deploy."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                return
+            entry.versions.pop(int(version), None)
+            if not entry.versions:
+                del self._entries[name]
+
+    # -- lookup ------------------------------------------------------------
+    def entry(self, name: str) -> FleetEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"unknown model entry {name!r}; registered: "
+                           f"{sorted(self._entries) or 'none'}")
+        return entry
+
+    def get(self, name: str, version: Optional[int] = None) -> ModelVersion:
+        """Version ``version`` of entry ``name`` (default: latest)."""
+        entry = self.entry(name)
+        with self._lock:
+            if version is None:
+                version = max(entry.versions)
+            mv = entry.versions.get(int(version))
+        if mv is None:
+            raise KeyError(f"{name!r} has no version {version}; known: "
+                           f"{sorted(entry.versions)}")
+        return mv
+
+    def versions(self, name: str) -> List[int]:
+        entry = self.entry(name)
+        with self._lock:
+            return sorted(entry.versions)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-serializable catalog summary (``Fleet.varz`` embeds it)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return {e.name: e.as_dict() for e in entries}

@@ -1,0 +1,215 @@
+"""Zero-downtime version rollout: canary → promote | rollback.
+
+The TensorFlow-Serving shape (Olston et al. 2017) on this stack's
+primitives: the NEW version's :class:`~sparkdl_tpu.serving.server.
+Server` is built ALONGSIDE the stable one (both alive, both admitting),
+a deterministic counter routes a configurable traffic fraction to the
+canary, and the swap itself is a phase flip — after ``promote()`` every
+new request routes to the canary server while the old server drains
+gracefully (``close(drain=True)``), so a request ALWAYS completes on the
+version that admitted it and no in-flight request is ever failed by a
+swap.  ``rollback()`` is the mirror image: the canary drains, the stable
+server never noticed.
+
+No-recompile contract: both servers were built over the SAME entry fn
+(``registry.FleetEntry`` resolves once), so the engine layer's jit cache
+hands the canary the very compiled program the stable version runs.
+:meth:`Rollout.report` proves it per bucket — the shared ``jax.jit``
+object identity plus an executable-cache size that did NOT grow between
+rollout start and promote (``Server.executable_state``); the program
+fingerprints themselves are pinned against ``PROGRAMS.lock.json`` by
+``analysis.program``'s fleet enumeration hook.
+
+Fault sites: ``fleet.canary`` fires at each canary routing decision;
+``fleet.swap`` fires at the promote/rollback attempt — an injected
+swap-time fault aborts the phase flip with state UNCHANGED (both
+servers keep serving; the operator retries), which is exactly what the
+headline chaos test drives.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.faults import inject
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PHASE_CANARY = "canary"
+PHASE_PROMOTED = "promoted"
+PHASE_ROLLED_BACK = "rolled_back"
+
+
+class Rollout:
+    """One in-progress version transition for one fleet entry.
+
+    Built by :meth:`Fleet.start_rollout`; routing goes through
+    :meth:`route` (deterministic fraction: request ``n`` rides the
+    canary iff ``floor(n*f)`` advanced, so fraction 0.25 sends exactly
+    every 4th request, 0.0 none, 1.0 all).  The phase flip methods only
+    mutate THIS object's phase — the owning fleet swaps its own state
+    and drains the losing server after the flip succeeds, so a fault
+    injected at ``fleet.swap`` leaves the world exactly as it was.
+    """
+
+    def __init__(self, name: str, stable_version: int, stable_server,
+                 canary_version: int, canary_server, fraction: float,
+                 exec_before: Dict[int, Dict[str, Any]]):
+        if not 0.0 <= float(fraction) <= 1.0:
+            raise ValueError(f"canary fraction must be in [0, 1], got "
+                             f"{fraction}")
+        self.name = name
+        self.stable_version = int(stable_version)
+        self.stable_server = stable_server
+        self.canary_version = int(canary_version)
+        self.canary_server = canary_server
+        self._fraction = float(fraction)
+        self._exec_before = dict(exec_before)
+        self._lock = named_lock("fleet.rollout")
+        self._phase = PHASE_CANARY
+        self._n = 0
+        self._canary_n = 0
+
+    # -- state -------------------------------------------------------------
+    @property
+    def phase(self) -> str:
+        with self._lock:
+            return self._phase
+
+    @property
+    def active(self) -> bool:
+        return self.phase == PHASE_CANARY
+
+    @property
+    def fraction(self) -> float:
+        with self._lock:
+            return self._fraction
+
+    def set_fraction(self, fraction: float) -> None:
+        """Shift canary traffic mid-rollout (0.0 pauses it, 1.0 is a
+        full dark-launch before the promote)."""
+        if not 0.0 <= float(fraction) <= 1.0:
+            raise ValueError(f"canary fraction must be in [0, 1], got "
+                             f"{fraction}")
+        with self._lock:
+            self._fraction = float(fraction)
+
+    # -- routing -----------------------------------------------------------
+    def route(self) -> Tuple[int, Any, bool]:
+        """(version, server, is_canary) for the next request.  After a
+        phase flip, stale callers holding this object keep routing
+        CORRECTLY: promoted → canary server, rolled back → stable."""
+        with self._lock:
+            phase = self._phase
+            f = self._fraction
+        if phase == PHASE_PROMOTED:
+            return self.canary_version, self.canary_server, False
+        if phase == PHASE_ROLLED_BACK:
+            return self.stable_version, self.stable_server, False
+        inject("fleet.canary")
+        with self._lock:
+            self._n += 1
+            take = math.floor(self._n * f) > math.floor((self._n - 1) * f)
+            if take:
+                self._canary_n += 1
+        if take:
+            return self.canary_version, self.canary_server, True
+        return self.stable_version, self.stable_server, False
+
+    # -- phase flips -------------------------------------------------------
+    def promote(self) -> Dict[str, Any]:
+        """Make the canary the stable version.  The ``fleet.swap`` fault
+        site fires BEFORE any state changes; on injected failure both
+        versions keep serving and promote() can simply be retried.
+        Returns :meth:`report`."""
+        inject("fleet.swap")
+        with self._lock:
+            if self._phase != PHASE_CANARY:
+                raise RuntimeError(
+                    f"cannot promote {self.name!r}: rollout already "
+                    f"{self._phase}")
+            self._phase = PHASE_PROMOTED
+        logger.info("%s: promoted v%d over v%d", self.name,
+                    self.canary_version, self.stable_version)
+        return self.report()
+
+    def rollback(self) -> Dict[str, Any]:
+        """Abandon the canary; the stable version keeps serving.  Same
+        ``fleet.swap`` fault-site semantics as :meth:`promote`."""
+        inject("fleet.swap")
+        with self._lock:
+            if self._phase != PHASE_CANARY:
+                raise RuntimeError(
+                    f"cannot roll back {self.name!r}: rollout already "
+                    f"{self._phase}")
+            self._phase = PHASE_ROLLED_BACK
+        logger.info("%s: rolled back v%d, staying on v%d", self.name,
+                    self.canary_version, self.stable_version)
+        return self.report()
+
+    # -- introspection -----------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        """JSON-serializable swap report, including the no-recompile
+        proof: for every bucket both versions have touched, the canary's
+        engine must hold the SAME ``jax.jit`` object the stable engine
+        compiled (``shared_jit``), and that object's executable cache —
+        one GLOBAL counter for the whole shared jit, every bucket
+        reports the same number — may have grown only by buckets
+        compiled for the FIRST time during the rollout.  Any growth
+        beyond that is a same-shape re-jit, which the swap must never
+        cause: identical shapes/dtypes reuse the compiled program."""
+        now = self.canary_server.executable_state()
+        buckets: Dict[int, Dict[str, Any]] = {}
+        compared = False
+        reused = True
+        for b in sorted(set(self._exec_before) | set(now)):
+            before = self._exec_before.get(b)
+            cur = now.get(b)
+            shared = (before is not None and cur is not None
+                      and before["jit_id"] == cur["jit_id"])
+            buckets[b] = {
+                "shared_jit": shared,
+                "executables_before": (before or {}).get("executables"),
+                "executables_now": (cur or {}).get("executables"),
+            }
+            if before is not None and cur is not None:
+                compared = True
+                reused = reused and shared
+        def _cache_size(state: Dict[int, Dict[str, Any]]):
+            known = [v["executables"] for v in state.values()
+                     if v.get("executables") is not None]
+            return max(known) if known else None
+        size_before = _cache_size(self._exec_before)
+        size_now = _cache_size(now)
+        new_buckets = len(set(now) - set(self._exec_before))
+        if (size_before is not None and size_now is not None
+                and size_now > size_before + new_buckets):
+            reused = False
+        with self._lock:
+            status = {
+                "name": self.name,
+                "phase": self._phase,
+                "stable_version": self.stable_version,
+                "canary_version": self.canary_version,
+                "fraction": self._fraction,
+                "requests": self._n,
+                "canary_requests": self._canary_n,
+            }
+        status["buckets"] = buckets
+        status["no_recompile"] = bool(compared and reused)
+        return status
+
+    def status(self) -> Dict[str, Any]:
+        """The light form ``Fleet.varz`` embeds per model."""
+        with self._lock:
+            return {
+                "canary_version": self.canary_version,
+                "stable_version": self.stable_version,
+                "fraction": self._fraction,
+                "phase": self._phase,
+                "requests": self._n,
+                "canary_requests": self._canary_n,
+            }

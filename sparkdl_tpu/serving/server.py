@@ -68,24 +68,12 @@ def _resolve_model(model, variables, featurize: bool):
         if variables is not None:
             raise ValueError("variables must be None when serving a named "
                              "zoo model")
-        from sparkdl_tpu.transformers.named_image import (
-            _cached_model, zoo_compute_dtype_name, zoo_model_fn)
+        # the ONE zoo fn constructor — shared with _zoo_engine, the fleet
+        # registry, and the program auditor, so served == transformed ==
+        # audited
+        from sparkdl_tpu.transformers.named_image import zoo_serving_bundle
 
-        module, zoo_vars = _cached_model(model)
-        cdt = None
-        overrides = {}
-        if zoo_compute_dtype_name() == "bfloat16":
-            import jax.numpy as jnp
-            import numpy as _np
-
-            cdt = jnp.bfloat16
-            overrides = {"compute_dtype": jnp.bfloat16,
-                         "output_host_dtype": _np.float32}
-        # the ONE zoo fn constructor — shared with _zoo_engine and the
-        # program auditor, so served == transformed == audited
-        fn = zoo_model_fn(model, featurize=featurize, compute_dtype=cdt,
-                          module=module)
-        return fn, zoo_vars, overrides
+        return zoo_serving_bundle(model, featurize)
     if isinstance(model, ModelFunction):
         if variables is not None:
             raise ValueError("variables must be None when serving a "
@@ -387,6 +375,14 @@ class Server:
                 worst = max(worst or 0.0, remaining)
         return worst
 
+    def breaker_retry_after(self) -> Optional[float]:
+        """Public form of the per-submit breaker query: remaining
+        cool-down of the worst OPEN bucket breaker, or None when
+        admission is open.  The fleet front door consults this to shed
+        lowest-priority traffic first while a model's device is
+        failing."""
+        return self._breaker_retry_after()
+
     def health(self) -> Dict[str, Any]:
         """Liveness/readiness snapshot (JSON-serializable; also embedded
         in :meth:`varz`):
@@ -661,6 +657,40 @@ class Server:
 
     def queue_depth(self) -> int:
         return self._batcher.depth()
+
+    @property
+    def bucket_sizes(self) -> List[int]:
+        """The compiled bucket plan (mesh-rounded, de-duplicated)."""
+        return list(self._buckets)
+
+    @property
+    def max_queue(self) -> int:
+        return self._batcher.max_queue
+
+    def queue_pressure(self) -> float:
+        """Queue occupancy in [0, 1] — the admission-pressure signal the
+        fleet layer sheds lowest-priority traffic against."""
+        return self._batcher.depth() / max(1, self._batcher.max_queue)
+
+    def executable_state(self) -> Dict[int, Dict[str, Any]]:
+        """Per-bucket compiled-program identity: the ``id()`` of the
+        bucket engine's shared ``jax.jit`` object and that object's
+        executable-cache size.  Two servers over the SAME fn (a fleet
+        entry's v1 and v2) report equal ``jit_id`` per bucket, and a
+        hot-swap that truly reuses the compiled executable leaves
+        ``executables`` unchanged — the no-recompile proof
+        ``serving.fleet.rollout`` asserts at promote time."""
+        with self._engine_lock:
+            engines = dict(self._engines)
+        out: Dict[int, Dict[str, Any]] = {}
+        for b, eng in sorted(engines.items()):
+            compiled = eng._compiled
+            try:
+                n_exec = int(compiled._cache_size())
+            except (AttributeError, TypeError):  # older jax: identity only
+                n_exec = None
+            out[b] = {"jit_id": id(compiled), "executables": n_exec}
+        return out
 
     def stats(self) -> Dict[str, float]:
         """Snapshot of the serving metrics (counters, gauges, latency

@@ -97,6 +97,31 @@ def zoo_model_fn(name: str, featurize: bool, compute_dtype=None,
     return fn
 
 
+def zoo_serving_bundle(name: str, featurize: bool):
+    """``(fn, variables, engine_overrides)`` for serving zoo model
+    ``name`` — THE zoo resolution the online stack shares: weights via
+    the process cache, the fn through :func:`zoo_model_fn` (so served ==
+    transformed == audited stays true by construction), and the
+    ``SPARKDL_ZOO_COMPUTE_DTYPE`` contract as engine overrides (bf16
+    compute + f32 host cast under the bench configuration).  Used by
+    ``serving.server._resolve_model`` and the fleet model registry
+    (``serving.fleet.registry``); the registry resolves ONCE per entry
+    and reuses the fn across versions, which is what lets a hot-swapped
+    version reuse the compiled executable instead of re-jitting."""
+    module, zoo_vars = _cached_model(name)
+    cdt = None
+    overrides: Dict[str, object] = {}
+    if zoo_compute_dtype_name() == "bfloat16":
+        import jax.numpy as jnp
+
+        cdt = jnp.bfloat16
+        overrides = {"compute_dtype": jnp.bfloat16,
+                     "output_host_dtype": np.float32}
+    fn = zoo_model_fn(name, featurize=featurize, compute_dtype=cdt,
+                      module=module)
+    return fn, zoo_vars, overrides
+
+
 def _zoo_engine(name: str, featurize: bool, batch_size: int) -> InferenceEngine:
     """One cached engine per (model, cut, batch).
 

@@ -50,10 +50,16 @@ class Metrics:
         init=False, repr=False, compare=False)
 
     def incr(self, name: str, value: float = 1.0):
+        # float() on every recorder: numpy scalars (an np.float32 batch
+        # statistic, an np.int64 row count) must never enter the
+        # registry — json.dumps(Server.varz()) IS the monitoring
+        # endpoint body, and a leaked numpy scalar breaks it
+        value = float(value)
         with self._lock:
             self.counters[name] = self.counters.get(name, 0.0) + value
 
     def gauge(self, name: str, value: float):
+        value = float(value)
         with self._lock:
             self.gauges[name] = value
 
@@ -63,6 +69,7 @@ class Metrics:
             del series[:len(series) // 2]
 
     def record_time(self, name: str, seconds: float):
+        seconds = float(seconds)
         with self._lock:
             self._append_bounded(self.timings_s.setdefault(name, []),
                                  seconds)

@@ -1,0 +1,588 @@
+"""Fleet-serving tests (tier-1, CPU-only, 8-device virtual mesh).
+
+Pins ISSUE 7's contracts for ``sparkdl_tpu.serving.fleet``:
+
+* registry: monotonically numbered versions over ONE pinned fn per
+  entry (the no-recompile precondition), weights-only re-registration;
+* multi-model front door: results bitwise-match each model's own
+  ``InferenceEngine`` oracle; futures carry model/version/tenant tags;
+* zero-downtime hot-swap: canary → promote with ZERO failed in-flight
+  requests and a per-bucket no-recompile report (shared jit object,
+  executable cache unchanged) — plus the PROGRAMS.lock.json tie-in: the
+  fleet's enumerable program set IS the committed zoo × bucket set, and
+  v1/v2 builds produce the identical executable cache key/fingerprint;
+* rollback with requests still in flight on the canary version;
+* canary fractions 0.0 / 1.0 and the deterministic fraction counter;
+* admission: zero-quota tenants, token-bucket rate + burst, in-flight
+  caps, shed-lowest-priority-first under queue pressure;
+* varz JSON contract for BOTH Server and Fleet (numpy scalars must not
+  break ``json.dumps``);
+* the headline chaos test: version rollout under sustained mixed-tenant
+  load with injected ``fleet.swap``/``fleet.canary``/``fleet.admit``
+  faults — zero failed in-flight requests, bit-correct outputs vs the
+  per-version single-model oracles, quotas enforced exactly.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import faults
+from sparkdl_tpu.faults import FaultPlan
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.serving import (Fleet, QueueFullError, QuotaExceededError,
+                                 ServerClosedError, ServiceUnavailableError,
+                                 TenantQuota)
+from sparkdl_tpu.serving.fleet import (PRIORITY_HIGH, PRIORITY_LOW,
+                                       ModelRegistry)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan():
+    """Never leak a fault plan between tests."""
+    from sparkdl_tpu.faults import plan as plan_mod
+
+    prev = plan_mod._PLAN
+    yield
+    plan_mod._PLAN = prev
+
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"])
+
+
+def _fn2(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.sin(x @ variables["w"] + variables["b"])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(17)
+    w1 = {"w": rng.normal(size=(6, 4)).astype(np.float32)}
+    w2 = {"w": rng.normal(size=(6, 4)).astype(np.float32)}
+    wb = {"w": rng.normal(size=(6, 3)).astype(np.float32),
+          "b": rng.normal(size=(3,)).astype(np.float32)}
+    x = rng.normal(size=(48, 6)).astype(np.float32)
+    return w1, w2, wb, x
+
+
+def _oracle(fn, variables, x):
+    eng = InferenceEngine(fn, variables, device_batch_size=8)
+    return np.concatenate(
+        [np.asarray(o) for o in eng.map_batches([x], pipeline=False)])
+
+
+def _no_serving_threads(timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        left = [t.name for t in threading.enumerate()
+                if t.name.startswith("sparkdl-serving")]
+        if not left:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"wedged serving threads: {left}")
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_versions_monotonic_and_fn_pinned(setup):
+    w1, w2, _, _ = setup
+    reg = ModelRegistry()
+    v1 = reg.register("clf", _fn, w1)
+    v2 = reg.register("clf", variables=w2)
+    v3 = reg.register("clf")  # defaults to the entry's resolved weights
+    assert [v1.version, v2.version, v3.version] == [1, 2, 3]
+    assert reg.versions("clf") == [1, 2, 3]
+    assert reg.get("clf").version == 3          # latest
+    assert reg.get("clf", 2).variables is w2
+    assert v3.variables is w1                   # entry default
+    # ONE fn object per entry — the no-recompile precondition
+    entry = reg.entry("clf")
+    assert entry.fn is _fn
+    with pytest.raises(ValueError, match="WEIGHTS only"):
+        reg.register("clf", _fn2)
+    with pytest.raises(ValueError, match="first register"):
+        reg.register("brand-new")
+    with pytest.raises(KeyError, match="no version 9"):
+        reg.get("clf", 9)
+    with pytest.raises(KeyError, match="unknown model entry"):
+        reg.entry("nope")
+
+
+# -- multi-model front door -------------------------------------------------
+
+def test_multi_model_results_match_engine_oracles(setup):
+    w1, _, wb, x = setup
+    ref_a = _oracle(_fn, w1, x[:8])
+    ref_b = _oracle(_fn2, wb, x[:8])
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8]) as fleet:
+        fleet.add_model("a", _fn, w1)
+        fleet.add_model("b", _fn2, wb)
+        futs_a = [fleet.submit("a", x[i], tenant="t1") for i in range(8)]
+        futs_b = [fleet.submit("b", x[i], tenant="t2") for i in range(8)]
+        got_a = np.stack([np.asarray(f.result(timeout=60)) for f in futs_a])
+        got_b = np.stack([np.asarray(f.result(timeout=60)) for f in futs_b])
+        assert all(f.fleet_model == "a" and f.fleet_version == 1
+                   and f.fleet_tenant == "t1" and not f.fleet_canary
+                   for f in futs_a)
+        with pytest.raises(KeyError, match="not deployed"):
+            fleet.submit("nope", x[0])
+        with pytest.raises(ValueError, match="already deployed"):
+            fleet.add_model("a", _fn, w1)
+    np.testing.assert_array_equal(got_a, ref_a)
+    np.testing.assert_array_equal(got_b, ref_b)
+    _no_serving_threads()
+
+
+# -- hot swap ---------------------------------------------------------------
+
+def test_hot_swap_zero_downtime_and_no_recompile(setup):
+    w1, w2, _, x = setup
+    ref_v1 = _oracle(_fn, w1, x)
+    ref_v2 = _oracle(_fn, w2, x)
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8]) as fleet:
+        fleet.add_model("m", _fn, w1, warm_example=x[0])
+        for i in range(4):  # stable traffic compiles/warms v1
+            np.testing.assert_array_equal(
+                np.asarray(fleet.predict("m", x[i])), ref_v1[i])
+        fleet.add_version("m", w2, label="retrained")
+        ro = fleet.start_rollout("m", canary_fraction=0.5,
+                                 warm_example=x[0])
+        futs = [fleet.submit("m", x[i]) for i in range(8)]
+        rows = [np.asarray(f.result(timeout=60)) for f in futs]
+        # deterministic fraction: every 2nd request rode the canary
+        assert [f.fleet_canary for f in futs] == [False, True] * 4
+        for f, row, i in zip(futs, rows, range(8)):
+            np.testing.assert_array_equal(
+                row, ref_v2[i] if f.fleet_version == 2 else ref_v1[i])
+        report = fleet.promote("m")
+        assert report["phase"] == "promoted"
+        assert report["no_recompile"] is True
+        assert all(b["shared_jit"] for b in report["buckets"].values())
+        assert fleet.deployed_version("m") == 2
+        assert fleet.swap_report("m") == report
+        # post-swap traffic serves v2, bit-correct
+        f = fleet.submit("m", x[9])
+        np.testing.assert_array_equal(np.asarray(f.result(timeout=60)),
+                                      ref_v2[9])
+        assert f.fleet_version == 2 and not f.fleet_canary
+        with pytest.raises(RuntimeError, match="no rollout"):
+            fleet.promote("m")
+        assert ro.phase == "promoted"
+    _no_serving_threads()
+
+
+def test_canary_fraction_zero_and_one(setup):
+    w1, w2, _, x = setup
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8]) as fleet:
+        fleet.add_model("m", _fn, w1)
+        fleet.add_version("m", w2)
+        with pytest.raises(ValueError, match="fraction"):
+            fleet.start_rollout("m", canary_fraction=1.5)
+        ro = fleet.start_rollout("m", canary_fraction=0.0)
+        futs = [fleet.submit("m", x[i]) for i in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        assert all(not f.fleet_canary for f in futs)
+        assert ro.status()["canary_requests"] == 0
+        ro.set_fraction(1.0)  # dark-launch: everything rides the canary
+        futs = [fleet.submit("m", x[i]) for i in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        assert all(f.fleet_canary and f.fleet_version == 2 for f in futs)
+        fleet.rollback("m")
+        assert fleet.deployed_version("m") == 1
+        # a second rollout of the SAME registered version still works
+        ro2 = fleet.start_rollout("m", canary_fraction=1.0)
+        assert ro2.canary_version == 2
+        fleet.promote("m")
+        assert fleet.deployed_version("m") == 2
+    _no_serving_threads()
+
+
+def test_rollback_completes_inflight_on_canary_version(setup):
+    w1, w2, _, x = setup
+    ref_v1 = _oracle(_fn, w1, x)
+    ref_v2 = _oracle(_fn, w2, x)
+    # wait window much longer than the test: in-flight requests are still
+    # QUEUED on the canary when rollback fires — the drain must serve
+    # them on the version that admitted them (v2), not fail them
+    with Fleet(max_batch_size=8, max_wait_ms=2_000,
+               bucket_sizes=[8]) as fleet:
+        fleet.add_model("m", _fn, w1)
+        fleet.add_version("m", w2)
+        fleet.start_rollout("m", canary_fraction=1.0, warm_example=x[0])
+        inflight = [fleet.submit("m", x[i]) for i in range(4)]
+        assert all(f.fleet_version == 2 for f in inflight)
+        report = fleet.rollback("m")  # drains the canary server
+        assert report["phase"] == "rolled_back"
+        for i, f in enumerate(inflight):
+            np.testing.assert_array_equal(
+                np.asarray(f.result(timeout=60)), ref_v2[i])
+        # stable never stopped serving; new traffic is v1 again (settled
+        # by the context-exit drain — the 2s wait window never flushes)
+        f = fleet.submit("m", x[5])
+        assert f.fleet_version == 1
+        with pytest.raises(ValueError, match="already serving"):
+            fleet.start_rollout("m", version=1)
+    np.testing.assert_array_equal(np.asarray(f.result(timeout=60)),
+                                  ref_v1[5])
+    _no_serving_threads()
+
+
+def test_swap_report_allows_first_compile_of_new_bucket():
+    """The shared jit's executable counter is GLOBAL: a bucket compiled
+    for the first time mid-rollout may grow it by one without failing
+    the no-recompile proof; growth beyond the new buckets means a
+    same-shape re-jit and must fail it."""
+    from sparkdl_tpu.serving.fleet.rollout import Rollout
+
+    class _Srv:
+        def __init__(self, state):
+            self._state = state
+
+        def executable_state(self):
+            return {b: dict(v) for b, v in self._state.items()}
+
+    jid = 0xBEEF
+    before = {8: {"jit_id": jid, "executables": 1}}
+    now = {8: {"jit_id": jid, "executables": 2},
+           16: {"jit_id": jid, "executables": 2}}
+    ro = Rollout("m", 1, _Srv(before), 2, _Srv(now), 0.5,
+                 exec_before=before)
+    rep = ro.report()
+    assert rep["no_recompile"] is True  # growth == one new bucket
+    assert rep["buckets"][8]["shared_jit"] is True
+    now[8]["executables"] = now[16]["executables"] = 3
+    assert ro.report()["no_recompile"] is False  # same-shape re-jit
+    now[8]["executables"] = now[16]["executables"] = 2
+    now[8]["jit_id"] = jid + 1  # forked jit object: never shared
+    assert ro.report()["no_recompile"] is False
+
+
+# -- admission --------------------------------------------------------------
+
+def test_admission_refund_returns_token_and_slot():
+    """The swap-window re-route must not charge a tenant twice:
+    refund() frees the slot, returns the rate token, and backs out the
+    admitted count."""
+    from sparkdl_tpu.serving.fleet import AdmissionController
+
+    ac = AdmissionController(
+        quotas={"t": TenantQuota(rate_per_s=1e-6, burst=1,
+                                 max_inflight=4)})
+    ac.admit("t")
+    with pytest.raises(QuotaExceededError):  # bucket empty, no refill
+        ac.admit("t")
+    ac.refund("t")
+    ac.admit("t")  # the refunded token admits the retry
+    snap = ac.snapshot()["tenants"]["t"]
+    assert snap["admitted"] == 1  # the refunded admit was backed out
+    assert snap["inflight"] == 1
+    assert snap["shed"] == 1
+
+
+def test_cap_rejection_costs_no_token_and_zero_quota_burst():
+    """A capped-out rejection must not also burn rate quota, and
+    rate_per_s=0.0 stays deny-by-config even with an explicit burst."""
+    from sparkdl_tpu.serving.fleet import AdmissionController
+
+    ac = AdmissionController(
+        quotas={"t": TenantQuota(rate_per_s=1e-6, burst=2,
+                                 max_inflight=1)})
+    ac.admit("t")  # one token spent, slot 1/1
+    with pytest.raises(QuotaExceededError, match="in-flight cap"):
+        ac.admit("t")
+    ac.release("t")
+    ac.admit("t")  # the cap rejection burned no token: one remained
+    assert TenantQuota(rate_per_s=0.0, burst=100).effective_burst() == 0.0
+
+
+def test_add_model_failure_leaves_no_thread_and_name_reusable(setup):
+    """A failed deploy (warmup blows up) must leave nothing behind: no
+    live dispatcher thread and no catalog entry poisoning the name."""
+    w1, _, _, x = setup
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8]) as fleet:
+        with pytest.raises(Exception):
+            fleet.add_model("m", _fn, w1,
+                            warm_example=np.zeros((3, 3), np.float32))
+        _no_serving_threads()
+        assert "m" not in fleet.registry
+        fleet.add_model("m", _fn, w1, warm_example=x[0])  # name reusable
+        np.asarray(fleet.predict("m", x[0]))
+    _no_serving_threads()
+
+def test_zero_quota_tenant_always_shed(setup):
+    w1, _, _, x = setup
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8],
+               quotas={"banned": TenantQuota(rate_per_s=0.0)}) as fleet:
+        fleet.add_model("m", _fn, w1)
+        for _ in range(3):
+            with pytest.raises(QuotaExceededError, match="zero quota") as ei:
+                fleet.submit("m", x[0], tenant="banned")
+            assert ei.value.retry_after_s > 0
+            assert ei.value.tenant == "banned"
+        # other tenants are untouched
+        np.asarray(fleet.predict("m", x[0], tenant="ok"))
+        snap = fleet.admission.snapshot()
+        assert snap["tenants"]["banned"]["shed"] == 3
+        assert snap["tenants"]["banned"]["admitted"] == 0
+
+
+def test_rate_quota_token_bucket(setup):
+    w1, _, _, x = setup
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8],
+               quotas={"m1": TenantQuota(rate_per_s=200.0, burst=2)}
+               ) as fleet:
+        fleet.add_model("m", _fn, w1)
+        a = fleet.submit("m", x[0], tenant="m1")
+        b = fleet.submit("m", x[1], tenant="m1")
+        with pytest.raises(QuotaExceededError, match="rate quota") as ei:
+            fleet.submit("m", x[2], tenant="m1")
+        assert 0 < ei.value.retry_after_s <= 60.0
+        a.result(timeout=60), b.result(timeout=60)
+        time.sleep(0.1)  # 200/s refills a token in 5ms
+        c = fleet.submit("m", x[3], tenant="m1")
+        np.asarray(c.result(timeout=60))
+
+
+def test_inflight_cap_released_on_settle(setup):
+    w1, _, _, x = setup
+    fleet = Fleet(max_batch_size=64, max_wait_ms=10_000, bucket_sizes=[64],
+                  quotas={"cap": TenantQuota(max_inflight=2)})
+    try:
+        fleet.add_model("m", _fn, w1)
+        futs = [fleet.submit("m", x[i], tenant="cap") for i in range(2)]
+        with pytest.raises(QuotaExceededError, match="in-flight cap"):
+            fleet.submit("m", x[2], tenant="cap")
+        assert fleet.admission.inflight("cap") == 2
+        fleet.close(drain=True)  # settles the queued requests
+        for f in futs:
+            np.asarray(f.result(timeout=60))
+        assert fleet.admission.inflight("cap") == 0
+    finally:
+        fleet.close()
+    _no_serving_threads()
+
+
+def test_priority_shed_lowest_first_under_queue_pressure(setup):
+    w1, _, _, x = setup
+    # nothing flushes (batch never fills, wait is 10s): the queue IS the
+    # pressure signal.  max_queue=10 -> low sheds at depth >= 5 (0.5),
+    # normal at >= 8 (0.8), high boards until the server itself is full.
+    fleet = Fleet(max_batch_size=64, max_wait_ms=10_000, bucket_sizes=[64],
+                  max_queue=10,
+                  quotas={"gold": TenantQuota(priority=PRIORITY_HIGH),
+                          "scraper": TenantQuota(priority=PRIORITY_LOW)})
+    try:
+        fleet.add_model("m", _fn, w1)
+        futs = [fleet.submit("m", x[i], tenant="gold") for i in range(5)]
+        # depth 5/10: the low-priority tenant is shed FIRST...
+        with pytest.raises(ServiceUnavailableError, match="queue pressure"):
+            fleet.submit("m", x[0], tenant="scraper")
+        # ...while normal-priority tenants still board (0.5 <= p < 0.8)
+        futs += [fleet.submit("m", x[5 + i], tenant="norm")
+                 for i in range(3)]
+        with pytest.raises(ServiceUnavailableError, match="queue pressure"):
+            fleet.submit("m", x[0], tenant="norm")  # depth 8/10
+        # high priority boards to the brim, then hits the server's own
+        # backpressure (QueueFullError with retry_after) — the fleet
+        # gate never outranks the queue bound
+        futs += [fleet.submit("m", x[8 + i], tenant="gold")
+                 for i in range(2)]
+        with pytest.raises(QueueFullError) as ei:
+            fleet.submit("m", x[0], tenant="gold")
+        assert not isinstance(ei.value, QuotaExceededError)
+        assert ei.value.retry_after_s > 0
+        fleet.close(drain=True)  # everyone admitted gets served
+        for f in futs:
+            np.asarray(f.result(timeout=60))
+    finally:
+        fleet.close()
+    _no_serving_threads()
+
+
+# -- varz JSON contract -----------------------------------------------------
+
+def test_fleet_and_server_varz_json_with_numpy_scalars(setup):
+    w1, w2, _, x = setup
+    with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8]) as fleet:
+        fleet.add_model("m", _fn, w1)
+        np.asarray(fleet.predict("m", x[0], tenant="t"))
+        fleet.add_version("m", w2)
+        fleet.start_rollout("m", canary_fraction=1.0)
+        np.asarray(fleet.predict("m", x[1]))
+        fleet.promote("m")
+        # numpy scalars must be coerced at the recorder, not trusted to
+        # stay out: the docstring promises json.dumps(varz()) IS the
+        # monitoring endpoint body
+        fleet.metrics.incr("fleet.numpy_counter", np.float32(1.5))
+        fleet.metrics.gauge("fleet.numpy_gauge", np.int64(3))
+        fleet.metrics.record_time("fleet.numpy_time", np.float64(0.01))
+        fleet.metrics.observe("fleet.numpy_obs", np.float32(0.25))
+        v = fleet.varz()
+        body = json.loads(json.dumps(v))
+    assert body["fleet"]["models"]["m"]["version"] == 2
+    assert body["fleet"]["models"]["m"]["last_swap"]["no_recompile"] is True
+    assert body["fleet"]["registry"]["m"]["versions"] == [1, 2]
+    assert body["tenants"]["t"]["completed"] == 1
+    assert body["admission"]["tenants"]["t"]["admitted"] == 1
+    assert body["counters"]["fleet.swaps"] == 1
+    assert body["health"]["state"] == "ready"
+    assert body["metrics"]["counters"]["fleet.numpy_counter"] == 1.5
+
+
+def test_server_varz_json_with_numpy_scalars(setup):
+    from sparkdl_tpu.serving import Server
+
+    w1, _, _, x = setup
+    with Server(_fn, w1, max_batch_size=8, max_wait_ms=2,
+                bucket_sizes=[8]) as srv:
+        np.asarray(srv.predict(x[0]))
+        srv.metrics.incr("serving.numpy_counter", np.float32(2.5))
+        srv.metrics.gauge("serving.numpy_gauge", np.int64(7))
+        srv.metrics.record_time("serving.numpy_time", np.float64(0.02))
+        body = json.loads(json.dumps(srv.varz()))
+    assert body["counters"]["serving.numpy_counter"] == 2.5
+    assert body["metrics"]["gauges"]["serving.numpy_gauge"] == 7.0
+
+
+# -- program audit tie-in ---------------------------------------------------
+
+def test_fleet_sites_registered():
+    from sparkdl_tpu.faults.sites import SITES, validate_site
+
+    for site in ("fleet.admit", "fleet.canary", "fleet.swap"):
+        assert validate_site(site) == site
+        assert site in SITES
+
+
+def test_fleet_program_set_is_the_committed_zoo_set():
+    """The fleet enumeration hook adds NO programs: its set is exactly
+    the zoo × bucket plan already in PROGRAMS.lock.json, and building
+    the SAME spec twice (a v1 and a v2 of a fleet entry, worst case:
+    fresh fn objects) yields the identical executable cache key and
+    StableHLO fingerprint — the committed-lockfile form of the
+    no-recompile hot-swap guarantee."""
+    from sparkdl_tpu.analysis.program import (DEFAULT_LOCKFILE,
+                                              audit_program,
+                                              fleet_dispatch_specs,
+                                              read_lockfile)
+    from sparkdl_tpu.analysis.program.inventory import zoo_dispatch_specs
+
+    fleet_specs = fleet_dispatch_specs(models=["MobileNetV2"],
+                                       max_batch_size=8)
+    zoo_specs = zoo_dispatch_specs(models=["MobileNetV2"], max_batch_size=8)
+    assert [s.name for s in fleet_specs] == [s.name for s in zoo_specs]
+    committed = read_lockfile(DEFAULT_LOCKFILE)["programs"]
+    assert {s.name for s in fleet_specs} <= set(committed)
+    spec_v1 = fleet_specs[0]  # featurize b8 — cheapest zoo lowering
+    spec_v2 = fleet_dispatch_specs(models=["MobileNetV2"],
+                                   max_batch_size=8)[0]
+    rec1 = audit_program(spec_v1)["record"]
+    rec2 = audit_program(spec_v2)["record"]
+    base = committed[spec_v1.name]
+    assert (rec1["in_avals"]["key"] == rec2["in_avals"]["key"]
+            == base["in_avals"]["key"])
+    assert (rec1["fingerprint"] == rec2["fingerprint"]
+            == base["fingerprint"])
+
+
+# -- the headline chaos test ------------------------------------------------
+
+def test_chaos_rollout_under_mixed_tenant_load(setup):
+    """ISSUE 7 acceptance: roll a model version under sustained
+    mixed-tenant load with injected swap-time faults.  Zero failed
+    in-flight requests (every admitted future resolves), bit-correct
+    outputs vs the per-version single-model oracles, quotas enforced
+    exactly, and the first promote attempt dying on the injected
+    ``fleet.swap`` fault leaves both versions serving (retry wins)."""
+    w1, w2, _, x = setup
+    ref = {1: _oracle(_fn, w1, x), 2: _oracle(_fn, w2, x)}
+    plan = FaultPlan.parse(
+        "seed=11;"
+        "fleet.swap:error:exc=transient,at=1,times=1;"
+        "fleet.canary:sleep:ms=1,every=7;"
+        "fleet.admit:error:exc=queue_full,at=40,times=1,retry_after=0.02")
+
+    settled = []          # (future, row_index) for every ADMITTED request
+    sheds = {"quota": 0, "storm": 0}
+    shed_lock = threading.Lock()
+
+    with faults.active(plan):
+        with Fleet(max_batch_size=8, max_wait_ms=2, bucket_sizes=[8],
+                   quotas={"metered": TenantQuota(rate_per_s=1e-4,
+                                                  burst=5)}) as fleet:
+            fleet.add_model("m", _fn, w1, warm_example=x[0])
+            fleet.add_version("m", w2)
+
+            def client(tenant, n_requests):
+                for k in range(n_requests):
+                    i = k % len(x)
+                    try:
+                        fut = fleet.submit("m", x[i], tenant=tenant)
+                    except QuotaExceededError:
+                        with shed_lock:
+                            sheds["quota"] += 1
+                    except QueueFullError as e:  # the injected storm
+                        assert e.retry_after_s > 0
+                        with shed_lock:
+                            sheds["storm"] += 1
+                    else:
+                        with shed_lock:
+                            settled.append((fut, i))
+                    time.sleep(0.002)
+
+            threads = [threading.Thread(target=client, args=(t, 30))
+                       for t in ("gold", "silver", "metered")]
+            for t in threads:
+                t.start()
+            time.sleep(0.03)  # load is flowing; start the rollout
+            fleet.start_rollout("m", canary_fraction=0.5,
+                                warm_example=x[0])
+            time.sleep(0.03)
+            # the injected fleet.swap fault kills the FIRST promote
+            # attempt with state unchanged — both versions keep serving
+            with pytest.raises(faults.InjectedTransientError):
+                fleet.promote("m")
+            assert fleet.deployed_version("m") == 1
+            time.sleep(0.02)
+            report = fleet.promote("m")  # retry wins mid-load
+            assert report["no_recompile"] is True
+            for t in threads:
+                t.join()
+            # zero failed in-flight requests: every admitted future
+            # resolves, and every row is bit-correct for the version
+            # that served it
+            assert settled, "no requests were admitted"
+            for fut, i in settled:
+                row = np.asarray(fut.result(timeout=60))
+                np.testing.assert_array_equal(row, ref[fut.fleet_version][i])
+            versions = {fut.fleet_version for fut, _ in settled}
+            assert versions == {1, 2}  # load really spanned the swap
+            # quotas enforced exactly: burst 5, negligible refill -> the
+            # metered tenant lands exactly 5 of its 30 submissions
+            # (minus the one storm reject if it drew it)
+            snap = fleet.admission.snapshot()
+            assert snap["tenants"]["metered"]["admitted"] <= 5
+            assert (snap["tenants"]["metered"]["admitted"]
+                    + snap["tenants"]["metered"]["shed"]
+                    + (1 if sheds["storm"] else 0) >= 30)
+            assert sheds["quota"] >= 24
+            assert sheds["storm"] == 1  # the injected admission storm
+            assert fleet.deployed_version("m") == 2
+            h = fleet.health()
+            assert h["state"] == "ready"
+            json.dumps(fleet.varz())
+    stats = plan.stats()
+    assert stats["fleet.swap"]["fired"] == 1       # killed promote #1 only
+    assert stats["fleet.admit"]["fired"] == 1      # the storm
+    assert stats["fleet.canary"]["fired"] >= 1     # routing stalls ran
+    _no_serving_threads()
