@@ -35,8 +35,8 @@ transfers — D2H ~1-6 MB/s, ~120 ms dispatch round trip — and the 1-vCPU
 host; PERF.md) carry a self-describing ``env_bound`` marker.
 
 Env knobs: SPARKDL_BENCH_CONFIGS (comma list, default
-"1,1e2e,2,3,4,5,serving,fleet,pipeline" — headline first so a timed-out
-run still printed it; it is re-emitted last on completion),
+"1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming" — headline first so a
+timed-out run still printed it; it is re-emitted last on completion),
 SPARKDL_BENCH_BATCH (128), SPARKDL_BENCH_STEPS (20), SPARKDL_BENCH_DTYPE
 (bfloat16|float32), SPARKDL_BENCH_SERVING_REQUESTS (512),
 SPARKDL_BENCH_REPROBE_TIMEOUT (120), SPARKDL_RELAY_CACHE (last-good
@@ -62,8 +62,12 @@ chip-independent by design: "serving" (dynamic-batching throughput +
 p50/p99 latency on a synthetic model — host orchestration + XLA
 compute, pinned to host CPU on fallback), "fleet" (the multi-tenant
 front door with a mid-run zero-downtime version swap, same fallback),
-and "pipeline" (the host/device overlap proof on a synthetic sleep
-device, always CPU).  Per-config lines that drive the
+"pipeline" (the host/device overlap proof on a synthetic sleep
+device, always CPU), and "streaming" (exactly-once ingestion: an
+injected crash in the output->commit window mid-stream, then the
+measured clean resume — lag/recovery/redelivery stats stamped on the
+line, outputs checked bit-identical vs the batch oracle, always
+CPU).  Per-config lines that drive the
 streaming engine also carry the pipeline stage-stall ledger
 (``pipeline_stages``) so host-vs-device boundedness is visible per run.
 """
@@ -985,6 +989,116 @@ def bench_pipeline():
          })
 
 
+# Exactly-once streaming ingestion child (ISSUE 8): chip-free by
+# design, like "pipeline" — it measures the streaming/journal layer
+# (poll -> journal intent -> pipelined score -> atomic artifact ->
+# fsync commit), not the chip.  Two phases: an injected crash in the
+# output->commit window mid-stream (the exactly-once window), then the
+# MEASURED clean resume — so every line carries recovery/redelivery
+# stats and a bit-identical-vs-batch-oracle verdict alongside the
+# throughput number.
+_STREAMING_BENCH = r"""
+import json, os, tempfile, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu import faults, streaming
+from sparkdl_tpu.obs.export import metrics_snapshot
+from sparkdl_tpu.parallel.engine import InferenceEngine
+from sparkdl_tpu.utils.metrics import Metrics
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+    return jnp.tanh(x @ variables["w"])
+
+rng = np.random.default_rng(12)
+variables = {"w": rng.normal(size=(64, 32)).astype(np.float32)}
+n_chunks = int(os.environ.get("SPARKDL_BENCH_STREAM_CHUNKS", "48"))
+rows = 64
+payloads = [rng.normal(size=(rows, 64)).astype(np.float32)
+            for _ in range(n_chunks)]
+eng = InferenceEngine(_fn, variables, device_batch_size=rows)
+base = tempfile.mkdtemp(prefix="sparkdl_stream_bench_")
+jp = os.path.join(base, "journal.jsonl")
+out_dir = os.path.join(base, "out")
+
+# phase 1: crash mid-run between output write and journal commit
+sc1 = streaming.StreamScorer(
+    eng, streaming.MemorySource(payloads, finished=True),
+    journal_path=jp, out_dir=out_dir, pipeline=True)
+crash_at = max(2, n_chunks // 2)
+crashed = False
+with faults.active(faults.FaultPlan.parse(
+        f"stream.commit:error:exc=fatal,at={crash_at}")):
+    try:
+        sc1.run()
+    except faults.InjectedFatalError:
+        crashed = True
+
+# phase 2: the measured clean resume (no faults active)
+m = Metrics()
+sc2 = streaming.StreamScorer(
+    eng, streaming.MemorySource(payloads, finished=True),
+    journal_path=jp, out_dir=out_dir, pipeline=True, metrics=m)
+t0 = time.perf_counter()
+s2 = sc2.run()
+resume_s = time.perf_counter() - t0
+got = streaming.assemble_outputs(jp, out_dir)
+oracle = np.concatenate(
+    [np.asarray(o) for o in eng.map_batches(payloads, pipeline=False)],
+    axis=0)
+print(json.dumps({
+    "ips": round(s2["chunks_scored"] * rows / resume_s, 1),
+    "chunks": n_chunks,
+    "rows_per_chunk": rows,
+    "crashed_mid_run": crashed,
+    "resume_offset": s2["resume_offset"],
+    "redeliveries": s2["redeliveries"],
+    "duplicates_suppressed": s2["duplicates_suppressed"],
+    "recovery_bit_identical": bool(np.array_equal(got, oracle)),
+    "resume_s": round(resume_s, 3),
+    "watermark": s2["watermark"],
+    "lag_s_final": sc2.health()["lag_s"],
+    "metrics_snapshot": metrics_snapshot(m),
+}))
+"""
+
+
+def bench_streaming():
+    """Exactly-once streaming ingestion envelope: rows/sec through the
+    journal'd pipelined path on the RESUME leg of a crash-resume cycle
+    (the worst case — replay + dedupe + fresh chunks), with the
+    redelivery/lag/recovery ledger stamped on the line."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_STREAMING_BENCH, timeout_s=480, env=env)
+    emit("streaming",
+         "exactly-once streaming resume throughput (injected "
+         "output->commit crash, journal'd replay)",
+         prof["ips"], "rows/sec",
+         env_bound="synthetic: in-memory source + fsync'd journal on "
+                   "host CPU (measures the streaming/journal layer, "
+                   "not the chip)",
+         extra={
+             "chunks": prof["chunks"],
+             "rows_per_chunk": prof["rows_per_chunk"],
+             "crashed_mid_run": prof["crashed_mid_run"],
+             "resume_offset": prof["resume_offset"],
+             "redeliveries": prof["redeliveries"],
+             "duplicates_suppressed": prof["duplicates_suppressed"],
+             "recovery_bit_identical": prof["recovery_bit_identical"],
+             "resume_s": prof["resume_s"],
+             "watermark": prof["watermark"],
+             "lag_s_final": prof["lag_s_final"],
+             # the CHILD's registry (see bench_serving)
+             **({"metrics_snapshot": prof["metrics_snapshot"]}
+                if prof.get("metrics_snapshot") else {}),
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -995,14 +1109,16 @@ BENCHES = {
     "serving": bench_serving,
     "fleet": bench_fleet,
     "pipeline": bench_pipeline,
+    "streaming": bench_streaming,
 }
 
 
 # Configs that never need the chip: "serving" and "fleet" run on their
 # CPU fallback (they measure the serving/fleet envelopes —
-# queue/batching/admission/swap/dispatch) and "pipeline" simulates its
-# device with a deterministic sleep.
-_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline")
+# queue/batching/admission/swap/dispatch), "pipeline" simulates its
+# device with a deterministic sleep, and "streaming" measures the
+# journal'd crash-resume path on synthetic in-memory chunks.
+_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -1050,7 +1166,7 @@ def main():
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
-    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline"
+    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming"
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

@@ -48,6 +48,12 @@ if [[ ! -f tests/test_fleet.py ]]; then
        "swap test) would ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_stream_ingest.py ]]; then
+  echo "FATAL: tests/test_stream_ingest.py missing — the streaming" \
+       "subsystem (journal exactly-once, crash resume, stall watchdog," \
+       "SIGKILL chaos) would ship untested" >&2
+  exit 1
+fi
 if [[ ! -f tests/test_analysis.py ]]; then
   echo "FATAL: tests/test_analysis.py missing — the graftlint rules and" \
        "lock-order checker would ship untested" >&2
@@ -118,6 +124,28 @@ SPARKDL_FAULTS="seed=2;fleet.canary:sleep:ms=1,times=2" \
 # even if the wide target list ever changes.
 echo "== graftlint fleet package self-check =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/serving/fleet \
+  --sites-file sparkdl_tpu/faults/sites.py
+
+# Streaming stage (ISSUE 8 satellite): re-run the streaming-ingestion
+# suite with SPARKDL_FAULTS carrying real stream.* rules (the tests
+# install their own plans over it, but the env gate itself is then
+# exercised, and the benign bounded sleep at stream.source proves a
+# spec'd rule on the poll loop stalls without corrupting exactly-once
+# accounting) and SPARKDL_LOCKCHECK=1 so the streaming locks
+# (stream.journal/stream.state/stream.health/stream.source.feed) feed
+# the lock-order graph under injected stall/crash/replay schedules.
+# -k: the SIGKILL headline sets its own SPARKDL_FAULTS in its child —
+# re-running it here adds subprocess wall time and zero env-gate
+# coverage (same policy as the fault-suite stage above).
+echo "== streaming ingestion suite (SPARKDL_FAULTS active) =="
+SPARKDL_FAULTS="seed=3;stream.source:sleep:ms=1,times=2" \
+  SPARKDL_LOCKCHECK=1 \
+  timeout -k 10 300 python -m pytest tests/test_stream_ingest.py -q \
+  -k "not sigkill"
+# scoped self-check, same rationale as the fleet one: the streaming
+# package must stay SDL001-SDL007 clean with no pragmas.
+echo "== graftlint streaming package self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/streaming \
   --sites-file sparkdl_tpu/faults/sites.py
 
 # Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device
@@ -205,4 +233,68 @@ assert t_inject / n < 5e-6 and t_inject < 10 * t_noop + 0.05, (
     f"disabled inject() costs {t_inject / n * 1e6:.2f}us/call "
     f"(no-op: {t_noop / n * 1e6:.2f}us)")
 print("fault-injection overhead guard ok")
+PY
+
+# Streaming-overhead guard (ISSUE 8): with no stream rules active and
+# SPARKDL_TRACE=0, the streaming runner's per-chunk cost over a raw
+# map_batches pass is its durability work only — three journal fsyncs
+# plus one atomic artifact write per chunk — bounded absolutely, in the
+# same spirit as the disabled-tracing/disabled-inject guards above
+# (the generous bound covers loaded CI hosts and slow fsync media).
+echo "== streaming-overhead guard =="
+env -u SPARKDL_FAULTS python - <<'PY'
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu import faults, obs, streaming
+from sparkdl_tpu.parallel.engine import InferenceEngine
+
+obs.configure(enabled=False)   # SPARKDL_TRACE=0 equivalent
+faults.clear()                 # SPARKDL_FAULTS unset equivalent
+
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"])
+
+
+rng = np.random.default_rng(3)
+variables = {"w": rng.normal(size=(16, 8)).astype(np.float32)}
+eng = InferenceEngine(_fn, variables, device_batch_size=32)
+n = 64
+payloads = [rng.normal(size=(32, 16)).astype(np.float32)
+            for _ in range(n)]
+for _ in eng.map_batches(payloads, pipeline=False):  # warm the program
+    pass
+t0 = time.perf_counter()
+for _ in eng.map_batches(payloads, pipeline=False):
+    pass
+direct_s = time.perf_counter() - t0
+base = tempfile.mkdtemp(prefix="sparkdl_stream_guard_")
+sc = streaming.StreamScorer(
+    eng, streaming.MemorySource(payloads, finished=True),
+    journal_path=os.path.join(base, "j.jsonl"),
+    out_dir=os.path.join(base, "out"), pipeline=False)
+t0 = time.perf_counter()
+summary = sc.run()
+stream_s = time.perf_counter() - t0
+obs.configure_from_env()
+per_chunk_ms = max(0.0, stream_s - direct_s) / n * 1e3
+print(json.dumps({"direct_s": round(direct_s, 3),
+                  "stream_s": round(stream_s, 3),
+                  "per_chunk_overhead_ms": round(per_chunk_ms, 3)}))
+assert summary["chunks_scored"] == n, summary
+assert per_chunk_ms < 25.0, (
+    f"streaming runner adds {per_chunk_ms:.2f}ms/chunk over raw "
+    f"map_batches with journaling's durability floor expected under "
+    f"25ms — the disabled-faults/untraced streaming path has grown "
+    f"non-durability overhead")
+print("streaming-overhead guard ok")
 PY

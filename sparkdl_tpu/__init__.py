@@ -48,6 +48,10 @@ _LAZY = {
     # serving (online inference layer; "serving" exposes the module itself)
     "serving": "sparkdl_tpu.serving",
     "Server": "sparkdl_tpu.serving",
+    # streaming (exactly-once continuous scoring; module itself + the
+    # runner, mirroring the serving pair above)
+    "streaming": "sparkdl_tpu.streaming",
+    "StreamScorer": "sparkdl_tpu.streaming",
 }
 
 # Only advertise names whose modules actually exist, so `import *` works at
@@ -83,8 +87,10 @@ def __getattr__(name: str):
         raise AttributeError(
             f"sparkdl_tpu.{name} is declared in the public API but its "
             f"module {target!r} is unavailable: {e}") from e
-    # "imageIO"/"serving" expose the module itself (parity with
-    # `from sparkdl import imageIO`; `from sparkdl_tpu import serving`)
-    obj = mod if name in ("imageIO", "serving") else getattr(mod, name)
+    # "imageIO"/"serving"/"streaming" expose the module itself (parity
+    # with `from sparkdl import imageIO`; `from sparkdl_tpu import
+    # serving`)
+    obj = mod if name in ("imageIO", "serving", "streaming") else getattr(
+        mod, name)
     globals()[name] = obj
     return obj

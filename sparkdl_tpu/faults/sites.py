@@ -31,6 +31,14 @@ SITE_HELP = {
     "fleet.admit": "Fleet front-door admission (tenant quota/priority gate)",
     "fleet.canary": "Fleet canary routing decision during a rollout",
     "fleet.swap": "Fleet version swap attempt (rollout promote/rollback)",
+    "stream.source": ("StreamSource poll mid-iteration (a sleep is a "
+                      "stalled source the watchdog must catch; a "
+                      "transient error is a flaky feed the re-poll "
+                      "backoff absorbs)"),
+    "stream.commit": ("StreamScorer between output-artifact write and "
+                      "journal commit — the exactly-once crash window"),
+    "stream.resume": ("journal replay of an uncommitted chunk at "
+                      "restart (redelivery-time failure)"),
     "probe.device": "__graft_entry__ device-count relay probe",
     "bench.relay_probe": "bench.py relay profile probe",
     "io.decode": "host image decode, per row",

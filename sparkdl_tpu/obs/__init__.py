@@ -16,8 +16,10 @@ spans, and every run can export a machine-readable record.
 Instrumented surfaces: ``serving.Server``/``DynamicBatcher`` (request +
 micro-batch spans), ``parallel.engine.InferenceEngine`` (call/dispatch
 spans), ``parallel.pipeline.PipelinedRunner`` (per-stage spans with
-``block_until_ready``-bracketed device time), and ``bench.py`` (one
-trace artifact + metrics snapshot per config line).
+``block_until_ready``-bracketed device time),
+``streaming.StreamScorer`` (``stream.run``/``stream.chunk`` spans over
+the commit path + watermark/lag/redelivery metrics), and ``bench.py``
+(one trace artifact + metrics snapshot per config line).
 """
 
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir

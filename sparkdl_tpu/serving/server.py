@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -40,6 +39,7 @@ from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
 from sparkdl_tpu.serving.errors import (DispatchTimeoutError,
                                         ServerClosedError,
                                         ServiceUnavailableError)
+from sparkdl_tpu.utils.health import HealthTracker
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 from sparkdl_tpu.utils.retry import NON_RETRYABLE, with_retries
@@ -252,13 +252,9 @@ class Server:
         # degraded — even one an engine retry later absorbs — and the
         # next success notes ready), with a bounded transition history
         # so tests/operators can see degraded->ready recoveries that a
-        # point-in-time poll would race past.
-        self._health_lock = named_lock("serving.health")
-        self._health_state = "ready"
-        self._health_transitions: deque = deque(
-            [{"state": "ready", "t_monotonic": round(time.monotonic(), 3)}],
-            maxlen=64)
-        self._last_error: Optional[Dict[str, Any]] = None
+        # point-in-time poll would race past.  Shared with the streaming
+        # runner since ISSUE 8 (utils.health mirrors this contract).
+        self._health = HealthTracker("serving.health")
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
         self._engine_lock = named_lock("serving.engines")
@@ -335,25 +331,10 @@ class Server:
         """Record a failed dispatch attempt / batch: state -> degraded.
         Wired as every engine's ``on_dispatch_error`` hook, so faults an
         engine-level retry absorbs still leave a health trace."""
-        with self._health_lock:
-            self._last_error = {
-                "type": type(exc).__name__,
-                "error": str(exc)[:300],
-                "t_monotonic": round(time.monotonic(), 3),
-            }
-            if self._health_state != "degraded":
-                self._health_state = "degraded"
-                self._health_transitions.append(
-                    {"state": "degraded",
-                     "t_monotonic": round(time.monotonic(), 3)})
+        self._health.note_failure(exc)
 
     def _note_success(self) -> None:
-        with self._health_lock:
-            if self._health_state != "ready":
-                self._health_state = "ready"
-                self._health_transitions.append(
-                    {"state": "ready",
-                     "t_monotonic": round(time.monotonic(), 3)})
+        self._health.note_success()
 
     def _breaker_states(self) -> Dict[int, Dict[str, Any]]:
         with self._engine_lock:
@@ -398,10 +379,10 @@ class Server:
           degraded->ready recovery is observable after the fact.
         """
         breakers = self._breaker_states()
-        with self._health_lock:
-            state = self._health_state
-            last_error = dict(self._last_error) if self._last_error else None
-            transitions = list(self._health_transitions)
+        snap = self._health.snapshot()
+        state = snap["state"]
+        last_error = snap["last_error"]
+        transitions = snap["transitions"]
         if any(st["state"] in ("open", "half_open")
                for st in breakers.values()):
             state = "degraded"

@@ -1,0 +1,54 @@
+"""sparkdl_tpu.streaming — exactly-once continuous scoring (ISSUE 8).
+
+Closes ROADMAP item 5: the stack scored batch DataFrames and served
+requests; this package makes it safe to sit on LIVE traffic.  A
+bounded, replayable :class:`StreamSource` yields ordered
+content-addressed chunks; :class:`StreamScorer` drives them through
+``map_batches``'s pipelined path (or a ``serving.Server`` sink) while a
+durable fsync'd :class:`Journal` records intent -> output-artifact ->
+commit per chunk — so a SIGKILL at ANY instant (including the window
+between output write and commit) restarts into a replay that is
+exactly-once and bit-identical to the batch oracle.  A stalled source
+degrades :meth:`StreamScorer.health` (the ``Server.health()`` contract)
+while seeded-backoff re-polling waits it out.
+
+Quick use::
+
+    from sparkdl_tpu import streaming
+
+    src = streaming.MemorySource([x0, x1, x2], finished=True)
+    scorer = streaming.StreamScorer(
+        engine, src, journal_path="j.jsonl", out_dir="out/")
+    scorer.run()                       # crash here? run() again: resumes
+    y = streaming.assemble_outputs("j.jsonl", "out/")
+"""
+
+from sparkdl_tpu.streaming.journal import (COMMIT, INTENT, OUTPUT, Journal,
+                                           JournalFormatError,
+                                           JournalWriteError)
+from sparkdl_tpu.streaming.runner import (StreamScorer, StreamStallError,
+                                          assemble_outputs)
+from sparkdl_tpu.streaming.source import (Chunk, DirectorySource,
+                                          MemorySource, StreamSource,
+                                          content_chunk_id,
+                                          finish_directory_stream,
+                                          write_directory_chunk)
+
+__all__ = [
+    "Chunk",
+    "StreamSource",
+    "MemorySource",
+    "DirectorySource",
+    "content_chunk_id",
+    "write_directory_chunk",
+    "finish_directory_stream",
+    "Journal",
+    "JournalWriteError",
+    "JournalFormatError",
+    "INTENT",
+    "OUTPUT",
+    "COMMIT",
+    "StreamScorer",
+    "StreamStallError",
+    "assemble_outputs",
+]

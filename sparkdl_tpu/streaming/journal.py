@@ -1,0 +1,208 @@
+"""Durable fsync'd JSONL commit journal — the exactly-once core (ISSUE 8).
+
+Three record kinds per chunk, appended strictly in this order through
+one :class:`~sparkdl_tpu.utils.jsonl.CrashSafeJsonlWriter` (one
+``write`` + ``fsync`` per record, so a record on disk is a record the
+kernel acked)::
+
+    {"rec": "intent", "chunk_id": "...", "offset": N}
+    {"rec": "output", "chunk_id": "...", "offset": N,
+     "artifact": "out-<id>.npy", "digest": "<sha256>"}
+    {"rec": "commit", "chunk_id": "...", "offset": N}
+
+The exactly-once argument, case by crash point:
+
+* killed before ``intent`` — the chunk was never scored; the replayable
+  source re-yields it on restart.  No output exists: **no loss**.
+* killed between ``intent``/``output`` and ``commit`` — an output
+  artifact may exist on disk, but artifacts are named by content-
+  addressed chunk id and written atomically, so the restart's replay
+  REWRITES the same path with the same bytes and then commits once.
+  **No duplicate** is possible: one id, one artifact, one commit.
+* killed mid-append — the torn trailing line is truncated by
+  :func:`~sparkdl_tpu.utils.jsonl.recover_jsonl` at reopen (a tear can
+  only ever eat the tail under the crash-safe write contract), leaving
+  the chunk in the previous case.
+* ``commit`` on disk — the chunk is done forever: restarts skip it by
+  id (:meth:`Journal.is_committed`), so re-delivery by a rewound source
+  is suppressed, and :meth:`Journal.commit` itself is idempotent (a
+  second commit for an id is a no-op, never a second record).
+
+Unlike the bench artifact (a rider on the real work), the journal IS
+the work: an append that cannot reach disk raises
+:class:`JournalWriteError` instead of silently disabling.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.utils.jsonl import CrashSafeJsonlWriter, recover_jsonl
+
+INTENT = "intent"
+OUTPUT = "output"
+COMMIT = "commit"
+_KINDS = (INTENT, OUTPUT, COMMIT)
+
+
+class JournalWriteError(RuntimeError):
+    """A journal append did not reach disk — the run must stop, because
+    progress past this point could neither resume nor dedupe."""
+
+
+class JournalFormatError(ValueError):
+    """A fully-written journal record has the wrong shape — version
+    drift or foreign data, not crash damage."""
+
+
+class Journal:
+    """One journal file == one stream's commit history (append-only;
+    restarts REPLAY the log into memory, they never rewrite it).
+
+    Construction recovers: the existing file is read through
+    ``recover_jsonl`` (torn tail truncated in place, fsync'd), every
+    record replays into the in-memory index, and the writer reopens in
+    append mode.  ``recovered_torn_bytes`` reports how much tail a
+    crash tore, for operators and tests.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        records, self.recovered_torn_bytes = recover_jsonl(path)
+        self._lock = named_lock("stream.journal")
+        self._intents: Dict[str, int] = {}
+        self._outputs: Dict[str, Dict[str, Any]] = {}
+        self._committed: Dict[str, int] = {}
+        for rec in records:
+            self._index(rec)
+        self._writer = CrashSafeJsonlWriter(path)
+
+    # -- replay ------------------------------------------------------------
+    def _index(self, rec: Dict[str, Any]) -> None:
+        kind = rec.get("rec")
+        cid = rec.get("chunk_id")
+        off = rec.get("offset")
+        if kind not in _KINDS or not isinstance(cid, str) \
+                or not isinstance(off, int):
+            raise JournalFormatError(
+                f"{self.path}: bad journal record {rec!r}")
+        if kind == INTENT:
+            self._intents[cid] = off
+        elif kind == OUTPUT:
+            self._outputs[cid] = dict(rec)
+        else:
+            self._committed.setdefault(cid, off)
+
+    # -- append ------------------------------------------------------------
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if not self._writer.write_line(json.dumps(rec)):
+            raise JournalWriteError(
+                f"journal append to {self.path} failed (disk full or "
+                f"read-only?) — cannot guarantee exactly-once past this "
+                f"point")
+
+    def begin(self, chunk_id: str, offset: int) -> None:
+        """Intent record: the chunk is about to be scored."""
+        with self._lock:
+            self._append({"rec": INTENT, "chunk_id": chunk_id,
+                          "offset": int(offset)})
+            self._intents[chunk_id] = int(offset)
+
+    def record_output(self, chunk_id: str, offset: int, artifact: str,
+                      digest: str) -> None:
+        """Output record: the artifact file is durably on disk (the
+        caller wrote + fsync'd + renamed it BEFORE this append)."""
+        with self._lock:
+            rec = {"rec": OUTPUT, "chunk_id": chunk_id,
+                   "offset": int(offset), "artifact": artifact,
+                   "digest": digest}
+            self._append(rec)
+            self._outputs[chunk_id] = rec
+
+    def commit(self, chunk_id: str, offset: int) -> bool:
+        """Commit record: the chunk is done forever.  Idempotent — a
+        duplicate commit (replay racing a recovered journal) returns
+        False and appends NOTHING, so the log carries at most one
+        commit per id."""
+        with self._lock:
+            if chunk_id in self._committed:
+                return False
+            self._append({"rec": COMMIT, "chunk_id": chunk_id,
+                          "offset": int(offset)})
+            self._committed[chunk_id] = int(offset)
+            return True
+
+    # -- queries -----------------------------------------------------------
+    def is_committed(self, chunk_id: str) -> bool:
+        with self._lock:
+            return chunk_id in self._committed
+
+    def seen(self, chunk_id: str) -> bool:
+        """An intent or output record exists — a restart processing this
+        chunk is a REDELIVERY, not first delivery (drives the
+        ``stream.redeliveries`` metric and the ``stream.resume`` fault
+        site)."""
+        with self._lock:
+            return chunk_id in self._intents or chunk_id in self._outputs
+
+    def output_record(self, chunk_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._outputs.get(chunk_id)
+            return dict(rec) if rec else None
+
+    def committed_ids(self) -> List[str]:
+        """Committed chunk ids in offset order."""
+        with self._lock:
+            return sorted(self._committed, key=self._committed.get)
+
+    def committed_count(self) -> int:
+        with self._lock:
+            return len(self._committed)
+
+    def committed_offsets(self) -> List[int]:
+        """Sorted committed offsets — the assembler's density check
+        (dense 0..n-1 == no gap, no duplicate)."""
+        with self._lock:
+            return sorted(self._committed.values())
+
+    def resume_offset(self) -> int:
+        """First offset NOT covered by the contiguous committed prefix —
+        where a restarted, in-order run seeks its source.  Chunks beyond
+        it that ARE committed (out-of-order history from a hand-built
+        journal) are suppressed by id at delivery, so a hole never
+        double-scores its neighbors."""
+        with self._lock:
+            done = set(self._committed.values())
+            n = 0
+            while n in done:
+                n += 1
+            return n
+
+    def uncommitted(self) -> List[Dict[str, Any]]:
+        """Chunks with an intent/output record but no commit — exactly
+        the replay set a restart owes the stream."""
+        with self._lock:
+            out: List[Dict[str, Any]] = []
+            for cid, off in sorted(self._intents.items(),
+                                   key=lambda kv: kv[1]):
+                if cid in self._committed:
+                    continue
+                rec = {"chunk_id": cid, "offset": off,
+                       "has_output": cid in self._outputs}
+                out.append(rec)
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "path": self.path,
+                "intents": len(self._intents),
+                "outputs": len(self._outputs),
+                "committed": len(self._committed),
+                "recovered_torn_bytes": self.recovered_torn_bytes,
+            }
+
+    def close(self) -> None:
+        self._writer.close()

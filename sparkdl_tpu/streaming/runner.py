@@ -1,0 +1,400 @@
+"""Crash-safe continuous scoring: source -> engine -> journal (ISSUE 8).
+
+:class:`StreamScorer` drives a replayable :class:`~sparkdl_tpu.
+streaming.source.StreamSource` through the engine's ``map_batches``
+pipelined path (or a ``serving.Server``-shaped sink), journaling every
+chunk through intent -> output-artifact -> commit so a SIGKILL at any
+instant resumes to exactly-once, bit-identical output:
+
+* chunk payloads flow through ONE ``map_batches`` call via a generator,
+  so host prepare of chunk ``k+1`` overlaps scoring of ``k`` exactly as
+  the offline path does (the generator is pulled on the pipeline's
+  prepare thread when ``pipeline=True``);
+* each scored chunk's output is written ATOMICALLY (tmp + fsync +
+  rename) to ``out-<chunk_id>.npy`` — content-addressed names make the
+  replay rewrite idempotent — then journaled and committed;
+* a restart builds the journal index (torn tail truncated), seeks the
+  source to the contiguous committed prefix, REPLAYS the uncommitted
+  suffix (counted as ``stream.redeliveries``), and suppresses by id any
+  chunk the journal already committed (``stream.duplicates_suppressed``);
+* a source that stops yielding past ``stall_deadline_s`` flips
+  :meth:`health` to ``degraded`` (same live/ready/degraded contract and
+  transitions deque as ``Server.health()``) while the runner keeps
+  re-polling with seeded jittered backoff; the next chunk recovers it.
+
+Fault sites: ``stream.source`` fires per poll (a ``sleep`` rule is a
+stalled source the watchdog must catch; a transient ``error`` is a
+flaky feed the backoff absorbs — other kinds propagate),
+``stream.commit`` sits in the window between output write and journal
+commit (the exactly-once crash point), and ``stream.resume`` fires when
+a restart replays a chunk a previous run left uncommitted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.faults import InjectedTransientError, inject
+from sparkdl_tpu.obs.trace import get_tracer
+from sparkdl_tpu.streaming.journal import Journal
+from sparkdl_tpu.streaming.source import Chunk, StreamSource
+from sparkdl_tpu.utils.health import HealthTracker
+from sparkdl_tpu.utils.logging import get_logger
+from sparkdl_tpu.utils.metrics import Metrics
+from sparkdl_tpu.utils.retry import backoff_delay
+
+logger = get_logger(__name__)
+
+
+class StreamStallError(RuntimeError):
+    """What ``health()["last_error"]`` records while the source is
+    stalled past the watchdog deadline (never raised by the runner —
+    the policy is degrade + keep re-polling, not crash)."""
+
+
+def _array_digest(arr: np.ndarray) -> str:
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _write_artifact_atomic(path: str, arr: np.ndarray) -> None:
+    """tmp + fsync + atomic rename: the artifact either exists whole or
+    not at all — a SIGKILL can never leave a torn .npy for the resumed
+    run (or the assembler) to trip over."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, arr, allow_pickle=False)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+
+
+class StreamScorer:
+    """Exactly-once continuous scorer; see the module docstring.
+
+    ``sink`` is an :class:`~sparkdl_tpu.parallel.engine.InferenceEngine`
+    (anything with ``map_batches`` — the pipelined default) or a
+    ``serving.Server``-shaped object (anything with ``submit`` returning
+    per-row futures; each chunk's rows ride the online queue and are
+    re-stacked in order).  Payloads and outputs are single numpy arrays
+    (one ``map_batches`` host batch per chunk).
+    """
+
+    def __init__(self, sink: Any, source: StreamSource, *,
+                 journal_path: str, out_dir: str,
+                 stall_deadline_s: float = 5.0,
+                 poll_backoff_s: float = 0.005,
+                 max_poll_backoff_s: float = 0.25,
+                 seed: int = 0,
+                 window: int = 2,
+                 pipeline: Optional[bool] = None,
+                 metrics: Optional[Metrics] = None):
+        if not (hasattr(sink, "map_batches") or hasattr(sink, "submit")):
+            raise TypeError(
+                f"sink {type(sink).__name__} has neither map_batches "
+                f"(engine) nor submit (server)")
+        self._sink = sink
+        self._source = source
+        self._journal = Journal(journal_path)
+        self._out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self._stall_deadline_s = float(stall_deadline_s)
+        self._poll_backoff_s = float(poll_backoff_s)
+        self._max_poll_backoff_s = float(max_poll_backoff_s)
+        self._rng = random.Random(f"stream:{seed}")
+        self._window = int(window)
+        self._pipeline = pipeline
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._health = HealthTracker("stream.health")
+        self._state_lock = named_lock("stream.state")
+        self._closed = False
+        self._finished = False
+        self._stalled = False
+        self._watermark = 0
+        self._last_progress = time.monotonic()
+
+    # -- journal / source plumbing -----------------------------------------
+    @property
+    def journal(self) -> Journal:
+        return self._journal
+
+    def close(self) -> None:
+        """Stop the run loop at the next chunk boundary (commits already
+        journaled stay committed — close is not rollback)."""
+        with self._state_lock:
+            self._closed = True
+        self._journal.close()
+
+    def _note_progress(self) -> None:
+        with self._state_lock:
+            self._last_progress = time.monotonic()
+            self._stalled = False
+
+    def _lag_s(self) -> float:
+        with self._state_lock:
+            if self._finished:
+                return 0.0
+            return time.monotonic() - self._last_progress
+
+    # -- watchdog poll loop ------------------------------------------------
+    def _next_chunk(self, begun: int,
+                    max_chunks: Optional[int]) -> Optional[Chunk]:
+        """Poll until a chunk, clean exhaustion, or close.  A silent
+        source past ``stall_deadline_s`` degrades health and keeps
+        re-polling with seeded jittered backoff (``utils.retry.
+        backoff_delay`` — the fleet-wide de-synchronization policy);
+        the next chunk flips health back to ready."""
+        attempt = 0
+        while True:
+            with self._state_lock:
+                if self._closed:
+                    return None
+            if max_chunks is not None and begun >= max_chunks:
+                return None
+            chunk = None
+            try:
+                inject("stream.source")
+                chunk = self._source.poll()
+            except InjectedTransientError as e:
+                # a flaky feed: count it, degrade, let backoff absorb it
+                self.metrics.incr("stream.source_errors")
+                self._health.note_failure(e)
+            if chunk is not None:
+                recovered = False
+                with self._state_lock:
+                    recovered = self._stalled
+                if recovered:
+                    self.metrics.incr("stream.stall_recoveries")
+                self._note_progress()
+                self._health.note_success()
+                self.metrics.gauge("stream.lag_seconds", self._lag_s())
+                return chunk
+            if self._source.exhausted():
+                with self._state_lock:
+                    self._finished = True
+                return None
+            lag = self._lag_s()
+            self.metrics.gauge("stream.lag_seconds", lag)
+            newly_stalled = False
+            if lag > self._stall_deadline_s:
+                with self._state_lock:
+                    newly_stalled = not self._stalled
+                    self._stalled = True
+            if newly_stalled:
+                self.metrics.incr("stream.stalls")
+                self._health.note_failure(StreamStallError(
+                    f"source silent for {lag:.3f}s (deadline "
+                    f"{self._stall_deadline_s:.3f}s); re-polling"))
+                logger.warning("stream source stalled (%.3fs > %.3fs)",
+                               lag, self._stall_deadline_s)
+            time.sleep(backoff_delay(
+                attempt, self._poll_backoff_s,
+                max_backoff_seconds=self._max_poll_backoff_s,
+                jitter=0.5, rng=self._rng))
+            attempt += 1
+
+    # -- the commit path ---------------------------------------------------
+    def _commit_chunk(self, chunk: Chunk, out: Any, t_recv: float) -> None:
+        """Output-artifact write -> output record -> [crash window] ->
+        commit.  Artifact names are the content-addressed chunk id, so
+        a replayed chunk REWRITES the identical file instead of adding a
+        second one — the no-duplicate half of exactly-once."""
+        arr = np.asarray(out)
+        name = f"out-{chunk.chunk_id}.npy"
+        _write_artifact_atomic(os.path.join(self._out_dir, name), arr)
+        self._journal.record_output(chunk.chunk_id, chunk.offset, name,
+                                    _array_digest(arr))
+        inject("stream.commit")
+        if self._journal.commit(chunk.chunk_id, chunk.offset):
+            self.metrics.incr("stream.commits")
+        with self._state_lock:
+            self._stalled = False
+            self._last_progress = time.monotonic()
+        self._watermark_update()
+        self.metrics.record_time("stream.chunk_latency",
+                                 time.monotonic() - t_recv)
+
+    def _watermark_update(self) -> None:
+        wm = self._journal.resume_offset()
+        with self._state_lock:
+            self._watermark = wm
+        self.metrics.gauge("stream.watermark", wm)
+        self.metrics.gauge("stream.lag_seconds", self._lag_s())
+
+    # -- run ---------------------------------------------------------------
+    def run(self, max_chunks: Optional[int] = None) -> Dict[str, Any]:
+        """Score the stream until the source is exhausted (or
+        ``max_chunks`` chunks have been scored, or :meth:`close`).
+
+        Resume-first: seeks the source to the journal's contiguous
+        committed prefix, replays uncommitted chunks (``stream.resume``
+        fires per replayed chunk), suppresses committed duplicates by
+        id, then streams new chunks through the sink.  Returns a
+        summary dict; raises on sink failure, non-transient source
+        faults, or a journal append that cannot reach disk (wrapped in
+        ``PipelineStageError`` naming the prepare stage when the
+        pipelined path is on).
+        """
+        resume_offset = self._journal.resume_offset()
+        summary: Dict[str, Any] = {
+            "resume_offset": resume_offset,
+            "recovered_torn_bytes": self._journal.recovered_torn_bytes,
+            "chunks_scored": 0,
+            "redeliveries": 0,
+            "duplicates_suppressed": 0,
+        }
+        self._source.seek(resume_offset)
+        with self._state_lock:
+            self._watermark = resume_offset
+            self._last_progress = time.monotonic()
+        self.metrics.gauge("stream.watermark", resume_offset)
+        tracer = get_tracer()
+        with tracer.span("stream.run", resume_offset=resume_offset):
+            try:
+                if hasattr(self._sink, "map_batches"):
+                    self._run_engine(summary, max_chunks)
+                else:
+                    self._run_serving(summary, max_chunks)
+                self._health.note_success()
+            except BaseException as e:
+                # the crash the journal exists for: record it for
+                # health()/post-mortem, then let the caller see it
+                self._health.note_failure(e)
+                raise
+        summary["watermark"] = self._journal.resume_offset()
+        summary["committed_total"] = self._journal.committed_count()
+        return summary
+
+    def _deliveries(self, summary: Dict[str, Any], pending: deque,
+                    max_chunks: Optional[int]) -> Iterator[Any]:
+        """The delivery generator both sink paths share: poll (with
+        watchdog), suppress committed duplicates, journal intent, track
+        the pending chunk, yield its payload.  Runs on the pipeline's
+        prepare thread when the engine path is pipelined."""
+        begun = 0
+        while True:
+            chunk = self._next_chunk(begun, max_chunks)
+            if chunk is None:
+                return
+            if self._journal.is_committed(chunk.chunk_id):
+                summary["duplicates_suppressed"] += 1
+                self.metrics.incr("stream.duplicates_suppressed")
+                continue
+            if self._journal.seen(chunk.chunk_id):
+                # a previous run began this chunk and died before commit
+                summary["redeliveries"] += 1
+                self.metrics.incr("stream.redeliveries")
+                inject("stream.resume")
+            self._journal.begin(chunk.chunk_id, chunk.offset)
+            self.metrics.incr("stream.chunks")
+            pending.append((chunk, time.monotonic()))
+            begun += 1
+            yield chunk.payload
+
+    def _run_engine(self, summary: Dict[str, Any],
+                    max_chunks: Optional[int]) -> None:
+        """One ``map_batches`` call over the delivery generator: chunk
+        k+1's poll/journal/prepare overlaps chunk k's dispatch+gather
+        on the pipelined path, while outputs — yielded strictly in
+        order — are committed on this thread."""
+        pending: deque = deque()
+        for out in self._sink.map_batches(
+                self._deliveries(summary, pending, max_chunks),
+                window=self._window, pipeline=self._pipeline):
+            chunk, t_recv = pending.popleft()
+            with get_tracer().span("stream.chunk", offset=chunk.offset,
+                                   chunk_id=chunk.chunk_id):
+                self._commit_chunk(chunk, out, t_recv)
+            summary["chunks_scored"] += 1
+
+    def _run_serving(self, summary: Dict[str, Any],
+                     max_chunks: Optional[int]) -> None:
+        """Server-sink path: each chunk's rows ride the online admission
+        queue as individual requests and are re-stacked in row order —
+        the journal neither knows nor cares which sink scored a chunk."""
+        pending: deque = deque()
+        for payload in self._deliveries(summary, pending, max_chunks):
+            chunk, t_recv = pending.popleft()
+            futs = [self._sink.submit(row) for row in payload]
+            out = np.stack([np.asarray(f.result()) for f in futs])
+            with get_tracer().span("stream.chunk", offset=chunk.offset,
+                                   chunk_id=chunk.chunk_id):
+                self._commit_chunk(chunk, out, t_recv)
+            summary["chunks_scored"] += 1
+
+    # -- health ------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """``Server.health()``'s live/ready/degraded contract for the
+        stream: ``state`` is ``degraded`` while the watermark lag
+        exceeds the watchdog deadline (or after an unrecovered
+        failure), with the same bounded ``transitions`` deque, plus the
+        stream's own ``watermark``/``lag_s``/``source_exhausted``."""
+        snap = self._health.snapshot()
+        with self._state_lock:
+            closed = self._closed
+            finished = self._finished
+            watermark = self._watermark
+            lag = (0.0 if finished
+                   else time.monotonic() - self._last_progress)
+        state = snap["state"]
+        if not finished and lag > self._stall_deadline_s:
+            state = "degraded"
+        if closed:
+            state = "closed"
+        return {
+            "live": not closed,
+            "state": state,
+            "last_error": snap["last_error"],
+            "transitions": snap["transitions"],
+            "watermark": watermark,
+            "lag_s": round(lag, 3),
+            "source_exhausted": finished,
+        }
+
+
+def assemble_outputs(journal_path: str, out_dir: str) -> np.ndarray:
+    """Fold the committed artifacts into one array, offset order —
+    the stream-side half of the exactly-once acceptance check (compare
+    against a batch ``map_batches`` oracle over the same chunks).
+
+    Verifies the journal's digests against the artifact bytes and that
+    committed offsets are dense (0..n-1): a gap or a duplicate offset
+    would be an at-most/at-least-once bug, so both raise.
+    """
+    j = Journal(journal_path)
+    try:
+        ids = j.committed_ids()
+        offsets = j.committed_offsets()
+        if offsets != list(range(len(offsets))):
+            raise ValueError(
+                f"committed offsets not dense: {offsets[:10]}... — "
+                f"exactly-once violated (gap or duplicate)")
+        parts = []
+        for cid in ids:
+            rec = j.output_record(cid)
+            if rec is None:
+                raise ValueError(f"committed chunk {cid} has no output "
+                                 f"record")
+            arr = np.load(os.path.join(out_dir, rec["artifact"]),
+                          allow_pickle=False)
+            if _array_digest(arr) != rec["digest"]:
+                raise ValueError(f"artifact {rec['artifact']} digest "
+                                 f"mismatch — torn or foreign file")
+            parts.append(arr)
+    finally:
+        j.close()
+    if not parts:
+        return np.empty((0,), np.float32)
+    return np.concatenate(parts, axis=0)

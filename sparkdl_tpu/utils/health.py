@@ -1,0 +1,75 @@
+"""Shared ready/degraded health state machine (ISSUE 4 / ISSUE 8).
+
+``Server.health()`` introduced the contract — ``ready`` <-> ``degraded``
+driven by failure/success outcomes, a ``last_error`` that survives
+recovery for post-mortems, and a bounded ``transitions`` history so a
+``degraded -> ready`` recovery is observable after a point-in-time poll
+would have raced past it.  The streaming runner mirrors the same
+contract for source stalls, so the state machine lives here once and
+both surfaces delegate to it.
+
+Timestamps are ``time.monotonic`` (never wall clock) — they exist to
+ORDER transitions and measure gaps, which wall-clock adjustments would
+corrupt (graftlint SDL006's rationale).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+
+
+class HealthTracker:
+    """The ready/degraded half of a ``health()`` snapshot.
+
+    Owners layer their own overrides on top (``closed``, breaker-open,
+    watermark lag) exactly as ``Server.health()`` always has — this
+    class only owns the failure/success-driven core state.
+    """
+
+    def __init__(self, lock_name: str, maxlen: int = 64):
+        self._lock = named_lock(lock_name)
+        self._state = "ready"
+        self._transitions: deque = deque(
+            [{"state": "ready", "t_monotonic": round(time.monotonic(), 3)}],
+            maxlen=maxlen)
+        self._last_error: Optional[Dict[str, Any]] = None
+
+    def note_failure(self, exc: BaseException) -> None:
+        """Record one failed attempt: state -> degraded (idempotent —
+        repeated failures extend the episode, not the history)."""
+        with self._lock:
+            self._last_error = {
+                "type": type(exc).__name__,
+                "error": str(exc)[:300],
+                "t_monotonic": round(time.monotonic(), 3),
+            }
+            if self._state != "degraded":
+                self._state = "degraded"
+                self._transitions.append(
+                    {"state": "degraded",
+                     "t_monotonic": round(time.monotonic(), 3)})
+
+    def note_success(self) -> None:
+        """Record recovery: state -> ready (no-op while already ready,
+        so steady-state success never grows the transition history)."""
+        with self._lock:
+            if self._state != "ready":
+                self._state = "ready"
+                self._transitions.append(
+                    {"state": "ready",
+                     "t_monotonic": round(time.monotonic(), 3)})
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable ``{"state", "last_error", "transitions"}``
+        (copies — callers may mutate freely)."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "last_error": (dict(self._last_error)
+                               if self._last_error else None),
+                "transitions": list(self._transitions),
+            }

@@ -1,23 +1,36 @@
-"""Crash-safe JSONL appender for driver artifacts.
+"""Crash-safe JSONL appending + torn-trailing-line recovery.
 
-The contract (ISSUE 4): a SIGKILL at ANY instant must leave a valid
-JSONL file containing every record written so far — atexit hooks never
-run under SIGKILL, so the only mechanism that survives one is flushing
-each record as it happens.  Each record is a single ``os.write`` of
-``line + "\\n"`` (a kill between records can never tear a line) followed
-by an ``fsync`` (the kernel has acked it to disk before the writer moves
-on).
+The write contract (ISSUE 4): a SIGKILL at ANY instant must leave a
+valid JSONL file containing every record written so far — atexit hooks
+never run under SIGKILL, so the only mechanism that survives one is
+flushing each record as it happens.  Each record is a single
+``os.write`` of ``line + "\\n"`` (a kill between records can never tear
+a line) followed by an ``fsync`` (the kernel has acked it to disk
+before the writer moves on).
 
 Failure policy: ``OSError`` (read-only checkout, full disk) DISABLES the
 writer instead of failing the run — the artifact is a rider on the real
 work (bench numbers, dryrun stages), never a reason to lose it.  Check
-:attr:`disabled` when the artifact is load-bearing.
+:attr:`disabled` (or ``write_line``'s return) when the record is
+load-bearing, as the streaming commit journal does.
+
+The read contract (ISSUE 8): :func:`read_jsonl` is the ONE tolerant
+reader for files written under this contract — a power loss or torn
+flush can leave at most one partial record at the TAIL, so a final line
+that fails to parse (or trailing bytes with no newline) is recoverable
+damage, while an unparsable line anywhere EARLIER is real corruption
+and raises.  :func:`recover_jsonl` additionally truncates the torn tail
+in place so the file can be re-opened for append — the restart half of
+the journal's torn-tail story.  Both ``bench.py``'s artifact and
+``sparkdl_tpu.streaming.journal`` ride this one implementation
+(contract-tested from both callers in tests/test_stream_ingest.py).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class CrashSafeJsonlWriter:
@@ -82,3 +95,74 @@ class CrashSafeJsonlWriter:
             except OSError:
                 pass
             self._fd = None
+
+
+class JsonlCorruptionError(ValueError):
+    """A record that is NOT the trailing line failed to parse — damage
+    the crash model cannot explain (a tear only ever eats the tail), so
+    the caller must not silently drop committed history."""
+
+
+def read_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Read a crash-safe JSONL file, tolerating a torn tail.
+
+    Returns ``(records, valid_bytes)`` where ``valid_bytes`` is the byte
+    offset of the end of the last GOOD record — everything after it (a
+    partial trailing record from a crash mid-write, or a final
+    newline-terminated line that does not parse) is the torn tail the
+    caller may discard.  A missing file reads as ``([], 0)``.  An
+    unparsable line that is not the last raises
+    :class:`JsonlCorruptionError`.
+    """
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], 0
+    records: List[Dict[str, Any]] = []
+    valid = 0
+    pos = 0
+    n = len(data)
+    while pos < n:
+        nl = data.find(b"\n", pos)
+        if nl < 0:
+            break  # trailing bytes with no newline: torn tail
+        line = data[pos:nl].strip()
+        if line:
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                if nl + 1 >= n:
+                    break  # unparsable FINAL line: torn tail
+                raise JsonlCorruptionError(
+                    f"{path}: unparsable record at byte {pos} is not the "
+                    f"trailing line — corruption, not a torn tail") from None
+        pos = nl + 1
+        valid = pos
+    return records, valid
+
+
+def recover_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """:func:`read_jsonl` + in-place truncation of the torn tail.
+
+    Returns ``(records, discarded_bytes)``.  After this call the file
+    ends exactly at the last good record, so re-opening it for append
+    (``CrashSafeJsonlWriter``) cannot interleave new records with torn
+    bytes.  The truncation is fsync'd — a crash right after recovery
+    must not resurrect the tail.
+    """
+    records, valid = read_jsonl(path)
+    discarded = 0
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return records, 0
+    if size > valid:
+        discarded = size - valid
+        fd = os.open(path, os.O_WRONLY)
+        try:
+            os.ftruncate(fd, valid)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    return records, discarded
