@@ -298,6 +298,17 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
         snap = metrics_snapshot(m)
         if any(snap.values()):
             rec["metrics_snapshot"] = snap
+    # the SLO rider (ISSUE 9): one-shot whole-run burn-rate rating of
+    # whatever default objectives the config's series support — the
+    # "did the run meet its objectives?" verdict next to the raw
+    # numbers.  extra wins for subprocess configs (the child's registry
+    # held the traffic; see metrics_snapshot above).
+    if m is not None and "slo" not in rec:
+        from sparkdl_tpu.obs.slo import slo_snapshot
+
+        slo = slo_snapshot(m)
+        if slo is not None:
+            rec["slo"] = slo
     ta = _CONFIG_OBS.get("trace_artifact")
     if ta is not None and "trace_artifact" not in rec:
         rec["trace_artifact"] = ta
@@ -785,6 +796,7 @@ elapsed = time.perf_counter() - t0
 m = srv.metrics
 fill = m.histograms.get("serving.batch_fill_ratio", [])
 from sparkdl_tpu.obs.export import metrics_snapshot
+from sparkdl_tpu.obs.slo import slo_snapshot
 out = {
     "ips": n / elapsed,
     "p50_ms": 1e3 * m.percentile("serving.request_latency", 50),
@@ -793,6 +805,7 @@ out = {
     "num_requests": n,
     "num_batches": int(m.counters.get("serving.batches", 0)),
     "metrics_snapshot": metrics_snapshot(m),
+    "slo": slo_snapshot(m),
 }
 srv.close()
 print(json.dumps(out))
@@ -838,6 +851,7 @@ def bench_serving():
              # the parent's per-config registry saw nothing
              **({"metrics_snapshot": prof["metrics_snapshot"]}
                 if prof.get("metrics_snapshot") else {}),
+             **({"slo": prof["slo"]} if prof.get("slo") else {}),
          })
 
 
@@ -888,6 +902,7 @@ for f in futs:
 elapsed = time.perf_counter() - t0
 m = fleet.metrics
 from sparkdl_tpu.obs.export import metrics_snapshot
+from sparkdl_tpu.obs.slo import slo_snapshot
 out = {
     "ips": len(futs) / elapsed,
     "p50_ms": 1e3 * m.percentile("fleet.request_latency", 50),
@@ -898,6 +913,7 @@ out = {
     "canary_requests": int(m.counters.get("fleet.canary_requests", 0)),
     "final_version": fleet.deployed_version("m"),
     "metrics_snapshot": metrics_snapshot(m),
+    "slo": slo_snapshot(m),
 }
 fleet.close()
 print(json.dumps(out))
@@ -938,6 +954,7 @@ def bench_fleet():
              # the CHILD's registry (see bench_serving)
              **({"metrics_snapshot": prof["metrics_snapshot"]}
                 if prof.get("metrics_snapshot") else {}),
+             **({"slo": prof["slo"]} if prof.get("slo") else {}),
          })
 
 
@@ -1004,6 +1021,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from sparkdl_tpu import faults, streaming
 from sparkdl_tpu.obs.export import metrics_snapshot
+from sparkdl_tpu.obs.slo import slo_snapshot
 from sparkdl_tpu.parallel.engine import InferenceEngine
 from sparkdl_tpu.utils.metrics import Metrics
 
@@ -1060,6 +1078,7 @@ print(json.dumps({
     "watermark": s2["watermark"],
     "lag_s_final": sc2.health()["lag_s"],
     "metrics_snapshot": metrics_snapshot(m),
+    "slo": slo_snapshot(m),
 }))
 """
 
@@ -1096,6 +1115,7 @@ def bench_streaming():
              # the CHILD's registry (see bench_serving)
              **({"metrics_snapshot": prof["metrics_snapshot"]}
                 if prof.get("metrics_snapshot") else {}),
+             **({"slo": prof["slo"]} if prof.get("slo") else {}),
          })
 
 

@@ -54,6 +54,13 @@ if [[ ! -f tests/test_stream_ingest.py ]]; then
        "SIGKILL chaos) would ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_flight.py ]]; then
+  echo "FATAL: tests/test_flight.py missing — the incident-observability" \
+       "layer (flight recorder, SLO burn-rate engine, blackbox timeline," \
+       "SIGKILL durability, headline causal-chain chaos) would ship" \
+       "untested" >&2
+  exit 1
+fi
 if [[ ! -f tests/test_analysis.py ]]; then
   echo "FATAL: tests/test_analysis.py missing — the graftlint rules and" \
        "lock-order checker would ship untested" >&2
@@ -124,7 +131,8 @@ SPARKDL_FAULTS="seed=2;fleet.canary:sleep:ms=1,times=2" \
 # even if the wide target list ever changes.
 echo "== graftlint fleet package self-check =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/serving/fleet \
-  --sites-file sparkdl_tpu/faults/sites.py
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py
 
 # Streaming stage (ISSUE 8 satellite): re-run the streaming-ingestion
 # suite with SPARKDL_FAULTS carrying real stream.* rules (the tests
@@ -146,7 +154,8 @@ SPARKDL_FAULTS="seed=3;stream.source:sleep:ms=1,times=2" \
 # package must stay SDL001-SDL007 clean with no pragmas.
 echo "== graftlint streaming package self-check =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/streaming \
-  --sites-file sparkdl_tpu/faults/sites.py
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py
 
 # Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device
 # benchmark must show that (a) DISABLED tracing (SPARKDL_TRACE=0) adds
@@ -298,3 +307,71 @@ assert per_chunk_ms < 25.0, (
     f"non-durability overhead")
 print("streaming-overhead guard ok")
 PY
+
+# Recorder-overhead guard (ISSUE 9 satellite): with SPARKDL_BLACKBOX
+# unset the flight_emit() sites threaded through state-change paths
+# must add no measurable overhead.  Same shape as the SPARKDL_TRACE=0
+# and disabled-inject guards above: (a) the synthetic slow-device
+# benchmark stays within the established 1.35x sleep-math bound with
+# the recorder OFF; (b) with the recorder ON the >= 1.5x overlap
+# contract still holds (the recorder only sees state CHANGES, never
+# per-batch traffic, so tier-1 wall time is unaffected); (c) the
+# disabled emit() call itself stays within an order of magnitude of a
+# plain no-op call (one module-global read + identity check).
+echo "== flight-recorder overhead guard =="
+env -u SPARKDL_BLACKBOX python - <<'PY'
+import json
+import timeit
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.parallel.pipeline import synthetic_overlap_benchmark
+
+flight.configure(enabled=False)        # SPARKDL_BLACKBOX unset equivalent
+off = synthetic_overlap_benchmark()
+flight.configure(enabled=True)         # SPARKDL_BLACKBOX=1 equivalent
+on = synthetic_overlap_benchmark()
+flight.configure(enabled=False)
+ideal = off["n_batches"] * max(off["prepare_ms"], off["dispatch_ms"]) / 1e3
+print(json.dumps({"ideal_s": ideal,
+                  "recorder_off_pipelined_s": off["pipelined_s"],
+                  "recorder_on_pipelined_s": on["pipelined_s"],
+                  "recorder_off_speedup": off["speedup"],
+                  "recorder_on_speedup": on["speedup"]}))
+assert off["pipelined_s"] <= 1.35 * ideal, (
+    f"recorder-off pipelined wall {off['pipelined_s']:.3f}s exceeds "
+    f"1.35x the {ideal:.1f}s ideal — the SPARKDL_BLACKBOX-unset path "
+    f"is no longer near-zero cost")
+assert off["speedup"] >= 1.5, off
+assert on["speedup"] >= 1.5, (
+    f"overlap contract broken WITH the recorder on: "
+    f"{on['speedup']:.2f}x < 1.5x")
+
+
+def noop(name):
+    return None
+
+
+n = 200_000
+t_emit = timeit.timeit(lambda: flight.emit("health.degraded"), number=n)
+t_noop = timeit.timeit(lambda: noop("health.degraded"), number=n)
+print(json.dumps({"emit_us": round(t_emit / n * 1e6, 3),
+                  "noop_us": round(t_noop / n * 1e6, 3)}))
+# generous bound (loaded CI hosts): disabled emit within 10x a no-op
+# call AND under 5us absolute — the faults.inject guard's exact bar
+assert t_emit / n < 5e-6 and t_emit < 10 * t_noop + 0.05, (
+    f"disabled flight.emit() costs {t_emit / n * 1e6:.2f}us/call "
+    f"(no-op: {t_noop / n * 1e6:.2f}us)")
+print("flight-recorder overhead guard ok")
+PY
+
+# Scoped self-check, same rationale as the fleet/streaming ones: the
+# obs package (now carrying the recorder + SLO engine) must stay
+# SDL001-SDL008 clean with no pragmas, with the flight-event catalog
+# read explicitly from its one source of truth.
+echo "== graftlint obs package self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/obs \
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py

@@ -26,6 +26,9 @@ SDL006 ``time.time()`` never feeds a latency subtraction
 SDL007 every ``jax.jit`` call site passes an explicit
        ``donate_argnums``/``donate_argnames`` (empty = decided "no");
        the lowered-program half is graftcheck GC001
+SDL008 flight-event strings exist in ``obs/flight.py`` ``EVENT_HELP``
+       (no typo'd black-box events — the SDL004 pattern for the
+       incident recorder)
 ====== ==================================================================
 
 Suppress with ``# graftlint: allow=SDLxxx reason=<why>`` on the
@@ -46,6 +49,9 @@ from typing import Iterable, List, Optional, Set
 from sparkdl_tpu.analysis.core import (Finding, LintContext, Module,
                                        collect_files, load_module,
                                        run_rules)
+from sparkdl_tpu.analysis.rules_flight import (load_event_registry,
+                                               load_event_registry_file,
+                                               rule_sdl008)
 from sparkdl_tpu.analysis.rules_hygiene import rule_sdl003, rule_sdl006
 from sparkdl_tpu.analysis.rules_jit import rule_sdl007
 from sparkdl_tpu.analysis.rules_obs import (rule_sdl005_names,
@@ -64,6 +70,8 @@ __all__ = [
     "lint_paths",
     "load_site_registry",
     "load_site_registry_file",
+    "load_event_registry",
+    "load_event_registry_file",
 ]
 
 ALL_RULES = (
@@ -75,6 +83,7 @@ ALL_RULES = (
     rule_sdl005_pairing,
     rule_sdl006,
     rule_sdl007,
+    rule_sdl008,
 )
 
 RULE_HELP = {
@@ -86,30 +95,38 @@ RULE_HELP = {
     "SDL005": "metric/span names dotted-lowercase; spans always closed",
     "SDL006": "time.time() never feeds a latency subtraction",
     "SDL007": "every jax.jit site decides donation explicitly",
+    "SDL008": "flight-event strings must exist in obs/flight.py",
 }
 
 
 def lint_source(source: str, path: str = "<string>",
-                sites: Optional[Set[str]] = None) -> List[Finding]:
+                sites: Optional[Set[str]] = None,
+                events: Optional[Set[str]] = None) -> List[Finding]:
     """Lint one in-memory snippet (the test-fixture entry point).
-    ``sites`` is the fault-site registry SDL004 checks against; None
-    means "no registry found", which SDL004 reports on any site use."""
+    ``sites``/``events`` are the fault-site registry and flight-event
+    catalog SDL004/SDL008 check against; None means "no registry
+    found", which each rule reports on any use."""
     try:
         module = load_module(source, path)
     except SyntaxError as e:
         return [Finding("SDL000", path, e.lineno or 1,
                         f"syntax error: {e.msg}")]
-    return run_rules(module, ALL_RULES, LintContext(sites=sites))
+    return run_rules(module, ALL_RULES,
+                     LintContext(sites=sites, events=events))
 
 
 def lint_paths(targets: Iterable[str],
-               sites: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint files/directories.  The fault-site registry is auto-located
-    under the targets unless passed explicitly."""
+               sites: Optional[Set[str]] = None,
+               events: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint files/directories.  The fault-site registry and flight-event
+    catalog are auto-located under the targets unless passed
+    explicitly."""
     targets = list(targets)
     if sites is None:
         sites = load_site_registry(targets)
-    ctx = LintContext(sites=sites)
+    if events is None:
+        events = load_event_registry(targets)
+    ctx = LintContext(sites=sites, events=events)
     findings: List[Finding] = []
     for path in collect_files(targets):
         with open(path, "r", encoding="utf-8") as fh:

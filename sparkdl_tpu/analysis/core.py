@@ -83,9 +83,11 @@ class Module:
 @dataclass
 class LintContext:
     """Cross-file state the rules share: the canonical fault-site
-    registry (None = SDL004 cannot run and reports that once)."""
+    registry (None = SDL004 cannot run and reports that once) and the
+    flight-event catalog (None = SDL008 likewise)."""
 
     sites: Optional[Set[str]] = None
+    events: Optional[Set[str]] = None
 
 
 def _scan_pragmas(source: str) -> tuple:
@@ -142,6 +144,65 @@ def collect_files(targets: Iterable[str]) -> List[str]:
                 if fn.endswith(".py"):
                     out.append(os.path.join(dirpath, fn))
     return sorted(set(out))
+
+
+def load_name_registry_file(path: str, dict_name: str,
+                            tuple_name: str) -> Optional[Set[str]]:
+    """Parse ONE registry file with ``ast`` (never by import): the keys
+    of a ``dict_name`` dict literal, falling back to a ``tuple_name``
+    tuple literal.  None when the file holds neither.  Shared by the
+    SDL004 fault-site and SDL008 flight-event loaders — one
+    implementation, so a blind spot (e.g. annotated assignments are
+    invisible) exists once, not per registry."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if dict_name in names and isinstance(node.value, ast.Dict):
+            keys = {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if keys:
+                return keys
+        if tuple_name in names and isinstance(node.value, ast.Tuple):
+            keys = {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+            if keys:
+                return keys
+    return None
+
+
+def locate_name_registry(targets: Iterable[str], parent_dir: str,
+                         basename: str, dict_name: str,
+                         tuple_name: str) -> Optional[Set[str]]:
+    """Auto-locate ``<parent_dir>/<basename>`` under the DIRECTORY
+    targets and extract its name set (plain-file targets contribute
+    only when they ARE a ``basename`` — linting ``bench.py`` must not
+    walk the whole checkout).  None when no registry file is found."""
+    candidates: List[str] = []
+    for t in targets:
+        if os.path.isfile(t):
+            if os.path.basename(t) == basename:
+                candidates.append(t)
+            continue
+        direct = os.path.join(t, parent_dir, basename)
+        if os.path.isfile(direct):
+            candidates.append(direct)
+            continue
+        for dirpath, dirnames, filenames in os.walk(t):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            if basename in filenames and \
+                    os.path.basename(dirpath) == parent_dir:
+                candidates.append(os.path.join(dirpath, basename))
+    for path in candidates:
+        names = load_name_registry_file(path, dict_name, tuple_name)
+        if names:
+            return names
+    return None
 
 
 def _suppressed(module: Module, finding: Finding) -> bool:

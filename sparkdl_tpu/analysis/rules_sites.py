@@ -11,10 +11,11 @@ at parse time — this closes the code-side half).  The registry is the
 from __future__ import annotations
 
 import ast
-import os
 from typing import Iterable, List, Optional, Set
 
-from sparkdl_tpu.analysis.core import Finding, LintContext, Module
+from sparkdl_tpu.analysis.core import (Finding, LintContext, Module,
+                                       load_name_registry_file,
+                                       locate_name_registry)
 
 _SITE_CALLS = {"inject", "has_rules"}
 
@@ -23,25 +24,7 @@ def load_site_registry_file(path: str) -> Optional[Set[str]]:
     """Parse ONE registry file (``--sites-file``): the keys of its
     ``SITE_HELP`` dict literal, falling back to a ``SITES`` tuple
     literal.  None when the file holds neither."""
-    with open(path, "r", encoding="utf-8") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
-        if "SITE_HELP" in names and isinstance(node.value, ast.Dict):
-            keys = {k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)}
-            if keys:
-                return keys
-        if "SITES" in names and isinstance(node.value, ast.Tuple):
-            keys = {e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)}
-            if keys:
-                return keys
-    return None
+    return load_name_registry_file(path, "SITE_HELP", "SITES")
 
 
 def load_site_registry(targets: Iterable[str]) -> Optional[Set[str]]:
@@ -50,27 +33,8 @@ def load_site_registry(targets: Iterable[str]) -> Optional[Set[str]]:
     are themselves a ``sites.py`` — linting ``bench.py`` must not walk
     the whole checkout).  None when no registry file is found; pass an
     explicit file through :func:`load_site_registry_file` instead."""
-    candidates: List[str] = []
-    for t in targets:
-        if os.path.isfile(t):
-            if os.path.basename(t) == "sites.py":
-                candidates.append(t)
-            continue
-        direct = os.path.join(t, "faults", "sites.py")
-        if os.path.isfile(direct):
-            candidates.append(direct)
-            continue
-        for dirpath, dirnames, filenames in os.walk(t):
-            dirnames[:] = [d for d in dirnames
-                           if d != "__pycache__" and not d.startswith(".")]
-            if "sites.py" in filenames and \
-                    os.path.basename(dirpath) == "faults":
-                candidates.append(os.path.join(dirpath, "sites.py"))
-    for path in candidates:
-        sites = load_site_registry_file(path)
-        if sites:
-            return sites
-    return None
+    return locate_name_registry(targets, "faults", "sites.py",
+                                "SITE_HELP", "SITES")
 
 
 def rule_sdl004(module: Module, ctx: LintContext) -> List[Finding]:

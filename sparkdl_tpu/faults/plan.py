@@ -31,6 +31,7 @@ from sparkdl_tpu.faults.errors import (InjectedDeadDeviceError,
                                        InjectedDecodeError, InjectedFault,
                                        InjectedFatalError,
                                        InjectedTransientError)
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.faults.sites import validate_site
 from sparkdl_tpu.faults.spec import (FaultRule, faults_from_env, format_spec,
                                      parse_spec)
@@ -136,9 +137,13 @@ class FaultPlan:
     def fire(self, site: str, ctx: Dict[str, Any]) -> None:
         """Advance ``site``'s call counter and run any due rules: raise
         (``error``/``dead``), stall (``sleep``, then keep evaluating), or
-        pass.  Called only while the plan is configured."""
+        pass.  Called only while the plan is configured.  Every rule
+        firing is recorded as a ``fault.fired`` flight event (outside
+        the plan lock, BEFORE the sleep/raise takes effect — so the
+        black box shows the injected cause ahead of its consequences)."""
         sleep_s = 0.0
         raise_exc: Optional[BaseException] = None
+        fired_rules: List[tuple] = []
         with self._lock:
             n = self._site_calls.get(site, 0) + 1
             self._site_calls[site] = n
@@ -155,6 +160,7 @@ class FaultPlan:
                     if not self._due(i, r, n):
                         continue
                     self._fired[i] = self._fired.get(i, 0) + 1
+                    fired_rules.append((r.clause, r.action, n))
                     msg = (f"injected {r.action} fault at {site} "
                            f"(rule [{r.clause}], call #{n})")
                     if r.action == "sleep":
@@ -171,6 +177,9 @@ class FaultPlan:
                         retry_after_s=float(r.params.get("retry_after",
                                                          0.05)))
                     break
+        for clause, action, call_n in fired_rules:
+            flight_emit("fault.fired", site=site, rule=clause,
+                        action=action, call=call_n)
         if sleep_s:
             time.sleep(sleep_s)
         if raise_exc is not None:

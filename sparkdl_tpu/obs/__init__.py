@@ -12,14 +12,30 @@ spans, and every run can export a machine-readable record.
   the :class:`~sparkdl_tpu.utils.metrics.Metrics` registry.
 * :mod:`~sparkdl_tpu.obs.exemplar` — top-K slowest request span trees,
   surfaced by ``Server.varz()``.
+* :mod:`~sparkdl_tpu.obs.flight` — the :class:`FlightRecorder` incident
+  black box: a bounded ring of structured state-change events
+  (``SPARKDL_BLACKBOX=0|1|dir`` gate, near-zero disabled path) durably
+  dumped on atexit/SIGTERM/ready->degraded; ``tools/blackbox.py`` folds
+  a dump + span JSONL + stream journal + bench artifact into one
+  trace-id-correlated incident timeline.
+* :mod:`~sparkdl_tpu.obs.slo` — declarative SLOs (availability, p99
+  latency, streaming watermark lag) evaluated with multi-window
+  burn-rate math over the existing ``Metrics`` series, feeding
+  ``HealthTracker`` degradation and surfacing in
+  ``Server.varz()``/``Fleet.varz()``/``StreamScorer.health()``.
 
 Instrumented surfaces: ``serving.Server``/``DynamicBatcher`` (request +
-micro-batch spans), ``parallel.engine.InferenceEngine`` (call/dispatch
-spans), ``parallel.pipeline.PipelinedRunner`` (per-stage spans with
-``block_until_ready``-bracketed device time),
-``streaming.StreamScorer`` (``stream.run``/``stream.chunk`` spans over
-the commit path + watermark/lag/redelivery metrics), and ``bench.py``
-(one trace artifact + metrics snapshot per config line).
+micro-batch spans; shed/drain flight events), ``parallel.engine.
+InferenceEngine`` (call/dispatch spans; breaker open/half-open/close
+flight events), ``parallel.pipeline.PipelinedRunner`` (per-stage spans
+with ``block_until_ready``-bracketed device time),
+``serving.fleet.Fleet`` (rollout start/promote/rollback + tenant-shed
+flight events), ``streaming.StreamScorer`` (``stream.run``/
+``stream.chunk`` spans + stall/redelivery/commit flight events),
+``utils.health.HealthTracker`` (ready<->degraded transition events),
+``faults`` (``fault.fired`` per injected rule firing), ``utils.retry``
+(``retry.attempt`` per re-execution), and ``bench.py`` (one trace
+artifact + metrics snapshot + ``slo`` snapshot per config line).
 """
 
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir
@@ -30,6 +46,10 @@ from sparkdl_tpu.obs.export import (load_spans, metrics_snapshot,
 from sparkdl_tpu.obs.trace import (NULL_SPAN, Span, Tracer, configure,
                                    configure_from_env, current_trace_id,
                                    get_tracer, tracing_from_env)
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs import slo as slo_module  # noqa: F401 — re-export
+from sparkdl_tpu.obs.flight import FlightRecorder, blackbox_from_env
+from sparkdl_tpu.obs.slo import SLO, SLOEngine, SLOViolation, slo_snapshot
 
 __all__ = [
     "Tracer",
@@ -48,4 +68,11 @@ __all__ = [
     "write_metrics_jsonl",
     "prometheus_text",
     "ExemplarReservoir",
+    "flight",
+    "FlightRecorder",
+    "blackbox_from_env",
+    "SLO",
+    "SLOEngine",
+    "SLOViolation",
+    "slo_snapshot",
 ]

@@ -32,6 +32,7 @@ import numpy as np
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.faults import inject
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.parallel import mesh as mesh_lib
 from sparkdl_tpu.parallel.pipeline import (PipelinedRunner,
@@ -89,31 +90,39 @@ class DispatchCircuitBreaker:
 
     def gate(self) -> None:
         """Fail fast with :class:`CircuitOpenError` while open; admit a
-        single trial dispatch once the cool-down elapses (half-open)."""
+        single trial dispatch once the cool-down elapses (half-open —
+        recorded as a ``breaker.half_open`` flight event, outside the
+        lock)."""
         if self.threshold <= 0:
             return
+        trial = False
         with self._lock:
-            if not self._open:
-                return
-            now = time_lib.monotonic()
-            remaining = self._open_until - now
-            if remaining > 0 or self._trial_inflight:
-                raise CircuitOpenError(
-                    f"dispatch circuit breaker open "
-                    f"({self._consecutive} consecutive device errors; "
-                    f"last: {self._last_error}); failing fast — retry in "
-                    f"{max(0.0, remaining):.2f}s",
-                    retry_after_s=max(0.0, remaining),
-                    last_error=self._last_error)
-            self._trial_inflight = True  # half-open: this caller probes
+            if self._open:
+                now = time_lib.monotonic()
+                remaining = self._open_until - now
+                if remaining > 0 or self._trial_inflight:
+                    raise CircuitOpenError(
+                        f"dispatch circuit breaker open "
+                        f"({self._consecutive} consecutive device errors; "
+                        f"last: {self._last_error}); failing fast — retry in "
+                        f"{max(0.0, remaining):.2f}s",
+                        retry_after_s=max(0.0, remaining),
+                        last_error=self._last_error)
+                self._trial_inflight = True  # half-open: this caller probes
+                trial = True
+        if trial:
+            flight_emit("breaker.half_open")
 
     def record_success(self) -> None:
         if self.threshold <= 0:
             return
         with self._lock:
+            closed_now = self._open
             self._consecutive = 0
             self._open = False
             self._trial_inflight = False
+        if closed_now:
+            flight_emit("breaker.close")
 
     def release_trial(self) -> None:
         """Give back a half-open trial slot WITHOUT judging the device
@@ -129,7 +138,8 @@ class DispatchCircuitBreaker:
 
     def record_failure(self, exc: BaseException) -> bool:
         """Count a device error; returns True when this failure OPENED
-        (or re-opened) the breaker."""
+        (or re-opened) the breaker — recorded as a ``breaker.open``
+        flight event outside the lock."""
         if self.threshold <= 0 or isinstance(exc, NON_RETRYABLE):
             return False
         with self._lock:
@@ -137,13 +147,18 @@ class DispatchCircuitBreaker:
             was_trial = self._trial_inflight
             self._trial_inflight = False
             self._last_error = f"{type(exc).__name__}: {exc}"
-            if was_trial or (not self._open
-                             and self._consecutive >= self.threshold):
+            opened = was_trial or (not self._open
+                                   and self._consecutive >= self.threshold)
+            if opened:
                 self._open = True
                 self._open_until = time_lib.monotonic() + self.cooldown_s
                 self._opened_count += 1
-                return True
-            return False
+            consecutive = self._consecutive
+        if opened:
+            flight_emit("breaker.open", consecutive=consecutive,
+                        cooldown_s=self.cooldown_s,
+                        error=type(exc).__name__)
+        return opened
 
     def open_remaining_s(self) -> Optional[float]:
         """Remaining cool-down if OPEN, else None — the cheap per-submit

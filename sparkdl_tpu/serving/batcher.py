@@ -21,6 +21,7 @@ from typing import Any, List, Optional
 
 from sparkdl_tpu.analysis.lockcheck import named_condition
 from sparkdl_tpu.faults import inject
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import (DeadlineExceededError, QueueFullError,
                                         ServerClosedError)
@@ -111,7 +112,11 @@ class DynamicBatcher:
     def submit(self, request: Request) -> None:
         """Admit one request or raise: ``ServerClosedError`` after close,
         ``QueueFullError`` (with a ``retry_after_s`` hint) when the queue
-        is at capacity — admission never blocks the caller."""
+        is at capacity — admission never blocks the caller.  A queue-full
+        shed is a ``serving.shed`` flight event (emitted AFTER the
+        batcher lock is released — the recorder never runs under the
+        locks it observes)."""
+        full = None
         with self._cond:
             if self._closed:
                 raise ServerClosedError("server is closed")
@@ -127,12 +132,19 @@ class DynamicBatcher:
                 # is (depth / batch) service periods.
                 periods = len(self._q) / self.max_batch_size
                 hint = max(1e-3, periods * self.batch_seconds_hint)
-                raise QueueFullError(
-                    f"admission queue full ({len(self._q)}/"
-                    f"{self.max_queue})", retry_after_s=hint)
-            self._q.append(request)
-            self.metrics.gauge("serving.queue_depth", float(len(self._q)))
-            self._cond.notify_all()
+                full = (len(self._q), hint)
+            else:
+                self._q.append(request)
+                self.metrics.gauge("serving.queue_depth",
+                                   float(len(self._q)))
+                self._cond.notify_all()
+        if full is not None:
+            depth, hint = full
+            flight_emit("serving.shed", reason="queue_full", depth=depth,
+                        retry_after_s=round(hint, 4))
+            raise QueueFullError(
+                f"admission queue full ({depth}/{self.max_queue})",
+                retry_after_s=hint)
 
     def depth(self) -> int:
         with self._cond:
@@ -204,6 +216,8 @@ class DynamicBatcher:
         for r in batch:
             if r.expired(now):
                 self.metrics.incr("serving.shed_deadline")
+                flight_emit("serving.shed", reason="deadline",
+                            waited_s=round(now - r.enqueued_at, 4))
                 try:
                     r.future.set_exception(DeadlineExceededError(
                         f"deadline expired after "

@@ -40,6 +40,7 @@ from typing import Any, Dict, Optional
 import time
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.serving.errors import (QuotaExceededError,
                                         ServiceUnavailableError)
 
@@ -134,6 +135,9 @@ class AdmissionController:
         q = self.quota(tenant)
         if unavailable_retry_after is not None and q.priority < PRIORITY_HIGH:
             self._note_shed(tenant)
+            flight_emit("fleet.shed", tenant=tenant, reason="breaker_open",
+                        priority=q.priority,
+                        retry_after_s=round(unavailable_retry_after, 4))
             raise ServiceUnavailableError(
                 f"tenant {tenant!r} (priority {q.priority}) shed: model "
                 f"circuit breaker open; retry in "
@@ -142,25 +146,32 @@ class AdmissionController:
         threshold = self.shed_pressure.get(q.priority, 1.01)
         if pressure >= threshold:
             self._note_shed(tenant)
+            flight_emit("fleet.shed", tenant=tenant, reason="pressure",
+                        priority=q.priority, pressure=round(pressure, 4))
             raise ServiceUnavailableError(
                 f"tenant {tenant!r} (priority {q.priority}) shed under "
                 f"queue pressure {pressure:.2f} (threshold "
                 f"{threshold:.2f}); higher-priority traffic boards first",
                 retry_after_s=0.05)
+        shed_exc: Optional[BaseException] = None
+        reason = None
         with self._lock:
             # cap check BEFORE the token charge: a capped-out rejection
             # must not also burn rate quota ("a shed request costs no
             # quota" — retrying clients at their cap would otherwise
-            # starve their own rate)
+            # starve their own rate).  Shed exceptions are built here
+            # but RAISED after the lock is released, so the fleet.shed
+            # flight event never fires under the admission lock.
             cap = q.max_inflight
             cur = self._inflight.get(tenant, 0)
             if cap is not None and cur >= int(cap):
                 self._shed[tenant] = self._shed.get(tenant, 0) + 1
-                raise QuotaExceededError(
+                reason = "inflight_cap"
+                shed_exc = QuotaExceededError(
                     f"tenant {tenant!r} at its in-flight cap ({cur}/"
                     f"{int(cap)}); retry when a request settles",
                     retry_after_s=0.05, tenant=tenant)
-            if q.rate_per_s is not None:
+            elif q.rate_per_s is not None:
                 rate = float(q.rate_per_s)
                 burst = q.effective_burst()
                 now = time.monotonic()
@@ -178,14 +189,22 @@ class AdmissionController:
                         msg = (f"tenant {tenant!r} rate quota exhausted "
                                f"({rate:g}/s, burst "
                                f"{burst:g}); retry in {hint:.3f}s")
+                        reason = "rate_quota"
                     else:
                         hint = self.retry_after_cap_s
                         msg = f"tenant {tenant!r} has zero quota"
-                    raise QuotaExceededError(msg, retry_after_s=hint,
-                                             tenant=tenant)
-                bucket[0] = tokens - 1.0
-            self._inflight[tenant] = cur + 1
-            self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+                        reason = "zero_quota"
+                    shed_exc = QuotaExceededError(msg, retry_after_s=hint,
+                                                  tenant=tenant)
+                else:
+                    bucket[0] = tokens - 1.0
+            if shed_exc is None:
+                self._inflight[tenant] = cur + 1
+                self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+        if shed_exc is not None:
+            flight_emit("fleet.shed", tenant=tenant, reason=reason,
+                        priority=q.priority)
+            raise shed_exc
         return q
 
     def release(self, tenant: str) -> None:

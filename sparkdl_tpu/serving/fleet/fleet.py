@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.faults import inject
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import ServerClosedError
 from sparkdl_tpu.serving.fleet.admission import (AdmissionController,
@@ -44,6 +45,7 @@ from sparkdl_tpu.serving.fleet.admission import (AdmissionController,
 from sparkdl_tpu.serving.fleet.registry import ModelRegistry, ModelVersion
 from sparkdl_tpu.serving.fleet.rollout import Rollout
 from sparkdl_tpu.serving.server import Server
+from sparkdl_tpu.utils.health import HealthTracker
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
 
@@ -78,6 +80,7 @@ class Fleet:
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  default_quota: Optional[TenantQuota] = None,
                  shed_pressure: Optional[Dict[int, float]] = None,
+                 slos: Optional[List[Any]] = None,
                  metrics: Optional[Metrics] = None,
                  **server_defaults):
         self.metrics = metrics if metrics is not None else Metrics()
@@ -85,6 +88,18 @@ class Fleet:
         self.admission = AdmissionController(
             quotas=quotas, default_quota=default_quota,
             shed_pressure=shed_pressure)
+        # Fleet-level health (ISSUE 9): the per-model servers keep their
+        # own trackers; this one carries fleet-wide objectives — an SLO
+        # burn-rate breach over the fleet.* series degrades it, and its
+        # snapshot is the last_error/transitions half of the unified
+        # health() payload.
+        self._health = HealthTracker("fleet.health")
+        self._slo_engine = None
+        if slos:
+            from sparkdl_tpu.obs.slo import SLOEngine
+
+            self._slo_engine = SLOEngine(self.metrics, slos,
+                                         health=self._health)
         self._server_defaults = dict(server_defaults)
         self._lock = named_lock("fleet.state")
         self._models: Dict[str, _ModelState] = {}
@@ -219,6 +234,10 @@ class Fleet:
             raise RuntimeError(f"cannot start rollout for {name!r}: "
                                f"{state_err}")
         self.metrics.incr("fleet.rollouts")
+        flight_emit("rollout.start", model=name,
+                    stable_version=ro.stable_version,
+                    canary_version=mv.version,
+                    fraction=float(canary_fraction))
         logger.info("fleet: rollout %s v%d -> v%d (canary %.0f%%)",
                     name, state.version, mv.version,
                     100 * canary_fraction)
@@ -242,6 +261,10 @@ class Fleet:
             state.last_swap_report = report
             closed = self._closed
         self.metrics.incr("fleet.swaps")
+        flight_emit("rollout.promote", model=name,
+                    version=ro.canary_version,
+                    drained_version=ro.stable_version,
+                    no_recompile=report.get("no_recompile"))
         # the old version drains OUTSIDE the state lock: new requests
         # already route to the promoted server while every in-flight v1
         # request completes on v1
@@ -266,6 +289,9 @@ class Fleet:
             state.rollout = None
             state.last_swap_report = report
         self.metrics.incr("fleet.rollbacks")
+        flight_emit("rollout.rollback", model=name,
+                    drained_version=ro.canary_version,
+                    version=ro.stable_version)
         ro.canary_server.close(drain=True)
         return report
 
@@ -388,9 +414,19 @@ class Fleet:
             return state.version
 
     def health(self) -> Dict[str, Any]:
-        """Aggregated liveness/readiness: fleet state is the WORST of
-        its models' server states (plus canary servers mid-rollout);
-        per-model detail nests each server's own ``health()``."""
+        """Aggregated liveness/readiness, built through the ONE
+        :meth:`~sparkdl_tpu.utils.health.HealthTracker.payload` schema
+        every ``health()`` in the stack shares (ISSUE 9): fleet state is
+        the WORST of its models' server states (plus canary servers
+        mid-rollout) and the fleet tracker's own state (SLO breaches);
+        per-model detail nests each server's own ``health()`` under the
+        ``models`` extra, and ``slo`` carries the objective evaluation
+        when ``slos=`` were configured."""
+        extra: Dict[str, Any] = {}
+        if self._slo_engine is not None:
+            # evaluate BEFORE the aggregation: a breach crossing on this
+            # very poll must already show as degraded
+            extra["slo"] = self._slo_engine.evaluate()
         with self._lock:
             models = dict(self._models)
             closed = self._closed
@@ -411,11 +447,12 @@ class Fleet:
             per[name] = entry
             if rank.get(h["state"], 1) > rank[worst]:
                 worst = "degraded"
-        return {
-            "live": not closed,
-            "state": "closed" if closed else worst,
-            "models": per,
-        }
+        if rank.get(self._health.snapshot()["state"], 1) > rank[worst]:
+            worst = "degraded"
+        return self._health.payload(
+            live=not closed,
+            state_override="closed" if closed else worst,
+            models=per, **extra)
 
     def stats(self) -> Dict[str, float]:
         """Flat fleet-level metrics summary (``fleet.*``)."""

@@ -33,6 +33,7 @@ import numpy as np
 from sparkdl_tpu.analysis.lockcheck import named_condition, named_lock
 from sparkdl_tpu.faults import inject
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.parallel.engine import CircuitOpenError
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
@@ -202,6 +203,11 @@ class Server:
         letting every request queue, dispatch into a dead device, and
         time out; :meth:`health` reports live/ready/degraded with the
         per-bucket breaker state and last error.
+      * ``slos`` — declarative :class:`~sparkdl_tpu.obs.slo.SLO`
+        objectives (ISSUE 9) evaluated over this server's metrics on
+        every :meth:`health`/:meth:`varz` poll; a burn-rate breach
+        degrades health (naming the objective in ``last_error``) and
+        the evaluation rides ``health()["slo"]``.
     """
 
     def __init__(self, model, variables: Any = None, *,
@@ -222,6 +228,7 @@ class Server:
                  dispatch_retries: int = 0,
                  breaker_threshold: int = 8,
                  breaker_cooldown_s: float = 30.0,
+                 slos: Optional[Sequence[Any]] = None,
                  metrics: Optional[Metrics] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
@@ -255,6 +262,16 @@ class Server:
         # point-in-time poll would race past.  Shared with the streaming
         # runner since ISSUE 8 (utils.health mirrors this contract).
         self._health = HealthTracker("serving.health")
+        # Declarative objectives (ISSUE 9): evaluated over THIS server's
+        # metrics on every health()/varz() poll; a burn-rate breach
+        # degrades the same tracker dispatch failures do, so "degraded"
+        # finally answers "against what objective?".
+        self._slo_engine = None
+        if slos:
+            from sparkdl_tpu.obs.slo import SLOEngine
+
+            self._slo_engine = SLOEngine(self.metrics, slos,
+                                         health=self._health)
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
         self._engine_lock = named_lock("serving.engines")
@@ -366,35 +383,39 @@ class Server:
 
     def health(self) -> Dict[str, Any]:
         """Liveness/readiness snapshot (JSON-serializable; also embedded
-        in :meth:`varz`):
+        in :meth:`varz`), built through the ONE
+        :meth:`~sparkdl_tpu.utils.health.HealthTracker.payload` schema
+        every ``health()`` in the stack shares (ISSUE 9):
 
         * ``live`` — the serving loop exists (False once closed);
         * ``state`` — ``ready`` (serving normally), ``degraded``
-          (breaker open/half-open, or a dispatch/batch failure with no
-          success since), or ``closed``;
+          (breaker open/half-open, SLO breach, or a dispatch/batch
+          failure with no success since), or ``closed``;
         * ``last_error`` — most recent failure (type/message/monotonic
           ts), surviving recovery for post-mortems;
-        * ``breaker`` — per-bucket engine circuit-breaker state;
         * ``transitions`` — bounded ready/degraded history, so a
-          degraded->ready recovery is observable after the fact.
+          degraded->ready recovery is observable after the fact;
+        * ``breaker`` — per-bucket engine circuit-breaker state (this
+          surface's extra);
+        * ``slo`` — the objective evaluation, when ``slos=`` were
+          configured (each ``health()`` poll takes one burn-rate
+          sample).
         """
+        extra: Dict[str, Any] = {}
+        if self._slo_engine is not None:
+            # evaluate BEFORE the snapshot: a breach crossing on this
+            # very poll must already show as degraded
+            extra["slo"] = self._slo_engine.evaluate()
         breakers = self._breaker_states()
-        snap = self._health.snapshot()
-        state = snap["state"]
-        last_error = snap["last_error"]
-        transitions = snap["transitions"]
+        state_override = None
         if any(st["state"] in ("open", "half_open")
                for st in breakers.values()):
-            state = "degraded"
+            state_override = "degraded"
         if self._closed:
-            state = "closed"
-        return {
-            "live": not self._closed,
-            "state": state,
-            "last_error": last_error,
-            "breaker": breakers,
-            "transitions": transitions,
-        }
+            state_override = "closed"
+        return self._health.payload(live=not self._closed,
+                                    state_override=state_override,
+                                    breaker=breakers, **extra)
 
     # -- request path ------------------------------------------------------
     def submit(self, example: Any,
@@ -419,6 +440,8 @@ class Server:
             # breaker sheds must be as well or the ratio breaks 1.0
             self.metrics.incr("serving.requests")
             self.metrics.incr("serving.rejected_breaker_open")
+            flight_emit("serving.shed", reason="breaker_open",
+                        retry_after_s=round(retry_after, 4))
             raise ServiceUnavailableError(
                 f"dispatch circuit breaker open (device failing); "
                 f"retry in {retry_after:.2f}s", retry_after_s=retry_after)
@@ -740,6 +763,8 @@ class Server:
             self._batcher.close(drain=drain)
             return
         self._closed = True
+        flight_emit("serving.drain", drain=drain,
+                    queued=self._batcher.depth())
         self._batcher.close(drain=drain)
         self._dispatcher.join(timeout=timeout_s)
         if self._dispatcher.is_alive():

@@ -43,6 +43,7 @@ import numpy as np
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.faults import InjectedTransientError, inject
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.streaming.journal import Journal
 from sparkdl_tpu.streaming.source import Chunk, StreamSource
@@ -100,6 +101,7 @@ class StreamScorer:
                  seed: int = 0,
                  window: int = 2,
                  pipeline: Optional[bool] = None,
+                 slos: Optional[Any] = None,
                  metrics: Optional[Metrics] = None):
         if not (hasattr(sink, "map_batches") or hasattr(sink, "submit")):
             raise TypeError(
@@ -118,6 +120,16 @@ class StreamScorer:
         self._pipeline = pipeline
         self.metrics = metrics if metrics is not None else Metrics()
         self._health = HealthTracker("stream.health")
+        # Declarative objectives (ISSUE 9): e.g. watermark lag against a
+        # freshness deadline, commit availability — evaluated on every
+        # health() poll; a burn-rate breach degrades the same tracker
+        # the stall watchdog does.
+        self._slo_engine = None
+        if slos:
+            from sparkdl_tpu.obs.slo import SLOEngine
+
+            self._slo_engine = SLOEngine(self.metrics, slos,
+                                         health=self._health)
         self._state_lock = named_lock("stream.state")
         self._closed = False
         self._finished = False
@@ -177,6 +189,8 @@ class StreamScorer:
                     recovered = self._stalled
                 if recovered:
                     self.metrics.incr("stream.stall_recoveries")
+                    flight_emit("stream.stall_recovered",
+                                offset=chunk.offset)
                 self._note_progress()
                 self._health.note_success()
                 self.metrics.gauge("stream.lag_seconds", self._lag_s())
@@ -194,6 +208,8 @@ class StreamScorer:
                     self._stalled = True
             if newly_stalled:
                 self.metrics.incr("stream.stalls")
+                flight_emit("stream.stall", lag_s=round(lag, 4),
+                            deadline_s=self._stall_deadline_s)
                 self._health.note_failure(StreamStallError(
                     f"source silent for {lag:.3f}s (deadline "
                     f"{self._stall_deadline_s:.3f}s); re-polling"))
@@ -219,6 +235,8 @@ class StreamScorer:
         inject("stream.commit")
         if self._journal.commit(chunk.chunk_id, chunk.offset):
             self.metrics.incr("stream.commits")
+            flight_emit("stream.commit", chunk_id=chunk.chunk_id,
+                        offset=chunk.offset)
         with self._state_lock:
             self._stalled = False
             self._last_progress = time.monotonic()
@@ -296,6 +314,8 @@ class StreamScorer:
                 # a previous run began this chunk and died before commit
                 summary["redeliveries"] += 1
                 self.metrics.incr("stream.redeliveries")
+                flight_emit("stream.redelivery", chunk_id=chunk.chunk_id,
+                            offset=chunk.offset)
                 inject("stream.resume")
             self._journal.begin(chunk.chunk_id, chunk.offset)
             self.metrics.incr("stream.chunks")
@@ -337,31 +357,34 @@ class StreamScorer:
     # -- health ------------------------------------------------------------
     def health(self) -> Dict[str, Any]:
         """``Server.health()``'s live/ready/degraded contract for the
-        stream: ``state`` is ``degraded`` while the watermark lag
-        exceeds the watchdog deadline (or after an unrecovered
-        failure), with the same bounded ``transitions`` deque, plus the
-        stream's own ``watermark``/``lag_s``/``source_exhausted``."""
-        snap = self._health.snapshot()
+        stream, built through the ONE :meth:`~sparkdl_tpu.utils.health.
+        HealthTracker.payload` schema every ``health()`` in the stack
+        shares (ISSUE 9): ``state`` is ``degraded`` while the watermark
+        lag exceeds the watchdog deadline (or after an unrecovered
+        failure / SLO breach), with the same bounded ``transitions``
+        deque, plus the stream's own ``watermark``/``lag_s``/
+        ``source_exhausted`` extras (and ``slo`` when objectives were
+        configured — each poll takes one burn-rate sample)."""
+        extra: Dict[str, Any] = {}
+        if self._slo_engine is not None:
+            # evaluate BEFORE the snapshot: a breach crossing on this
+            # very poll must already show as degraded
+            extra["slo"] = self._slo_engine.evaluate()
         with self._state_lock:
             closed = self._closed
             finished = self._finished
             watermark = self._watermark
             lag = (0.0 if finished
                    else time.monotonic() - self._last_progress)
-        state = snap["state"]
+        state_override = None
         if not finished and lag > self._stall_deadline_s:
-            state = "degraded"
+            state_override = "degraded"
         if closed:
-            state = "closed"
-        return {
-            "live": not closed,
-            "state": state,
-            "last_error": snap["last_error"],
-            "transitions": snap["transitions"],
-            "watermark": watermark,
-            "lag_s": round(lag, 3),
-            "source_exhausted": finished,
-        }
+            state_override = "closed"
+        return self._health.payload(
+            live=not closed, state_override=state_override,
+            watermark=watermark, lag_s=round(lag, 3),
+            source_exhausted=finished, **extra)
 
 
 def assemble_outputs(journal_path: str, out_dir: str) -> np.ndarray:

@@ -1,12 +1,23 @@
-"""Shared ready/degraded health state machine (ISSUE 4 / ISSUE 8).
+"""Shared ready/degraded health state machine (ISSUE 4 / 8 / 9).
 
 ``Server.health()`` introduced the contract — ``ready`` <-> ``degraded``
 driven by failure/success outcomes, a ``last_error`` that survives
 recovery for post-mortems, and a bounded ``transitions`` history so a
 ``degraded -> ready`` recovery is observable after a point-in-time poll
 would have raced past it.  The streaming runner mirrors the same
-contract for source stalls, so the state machine lives here once and
-both surfaces delegate to it.
+contract for source stalls, and the fleet aggregates it across models,
+so the state machine lives here once and every surface delegates to it.
+
+ISSUE 9 adds the payload side of the contract: every ``health()`` in
+the stack (``Server``, ``Fleet``, ``StreamScorer``) now BUILDS its
+snapshot through :func:`health_payload` / :meth:`HealthTracker.payload`
+— one schema (``live``/``state``/``last_error``/``transitions`` plus
+caller extras) that the flight recorder and ``tools/blackbox.py`` parse
+as a single contract (contract-tested from all three callers).  Each
+actual transition also emits a ``health.degraded``/``health.ready``
+flight event (outside the tracker lock), and a ready->degraded flip is
+the flight recorder's durable-dump trigger — degradation is exactly
+when the next instants stop being trustworthy.
 
 Timestamps are ``time.monotonic`` (never wall clock) — they exist to
 ORDER transitions and measure gaps, which wall-clock adjustments would
@@ -20,6 +31,37 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.obs.flight import emit as flight_emit
+
+#: The shared state vocabulary every health() surface speaks.
+HEALTH_STATES = ("ready", "degraded", "closed")
+
+
+def health_payload(*, live: bool, state: str,
+                   last_error: Optional[Dict[str, Any]] = None,
+                   transitions: Optional[list] = None,
+                   **extra: Any) -> Dict[str, Any]:
+    """THE ``health()`` schema: ``{"live", "state", "last_error",
+    "transitions"}`` plus caller-specific extras (``breaker`` for the
+    server, ``watermark``/``lag_s`` for the stream, ``models`` for the
+    fleet, ``slo`` when an engine is attached).  Extras may never
+    shadow a core key, and ``state`` must come from
+    :data:`HEALTH_STATES` — the single contract ``blackbox`` parses."""
+    if state not in HEALTH_STATES:
+        raise ValueError(f"health state must be one of {HEALTH_STATES}, "
+                         f"got {state!r}")
+    payload: Dict[str, Any] = {
+        "live": bool(live),
+        "state": state,
+        "last_error": last_error,
+        "transitions": list(transitions or []),
+    }
+    for k, v in extra.items():
+        if k in payload:
+            raise ValueError(f"health extra field {k!r} collides with a "
+                             f"core contract key")
+        payload[k] = v
+    return payload
 
 
 class HealthTracker:
@@ -27,11 +69,15 @@ class HealthTracker:
 
     Owners layer their own overrides on top (``closed``, breaker-open,
     watermark lag) exactly as ``Server.health()`` always has — this
-    class only owns the failure/success-driven core state.
+    class only owns the failure/success-driven core state.  ``name``
+    labels the tracker in flight events (defaults to ``lock_name``,
+    which every owner already picks uniquely).
     """
 
-    def __init__(self, lock_name: str, maxlen: int = 64):
+    def __init__(self, lock_name: str, maxlen: int = 64,
+                 name: Optional[str] = None):
         self._lock = named_lock(lock_name)
+        self.name = name if name is not None else lock_name
         self._state = "ready"
         self._transitions: deque = deque(
             [{"state": "ready", "t_monotonic": round(time.monotonic(), 3)}],
@@ -40,7 +86,11 @@ class HealthTracker:
 
     def note_failure(self, exc: BaseException) -> None:
         """Record one failed attempt: state -> degraded (idempotent —
-        repeated failures extend the episode, not the history)."""
+        repeated failures extend the episode, not the history).  An
+        actual transition emits ``health.degraded`` into the flight
+        recorder AFTER the lock is released (the recorder may fsync a
+        durable dump on this exact event)."""
+        transitioned = False
         with self._lock:
             self._last_error = {
                 "type": type(exc).__name__,
@@ -52,16 +102,24 @@ class HealthTracker:
                 self._transitions.append(
                     {"state": "degraded",
                      "t_monotonic": round(time.monotonic(), 3)})
+                transitioned = True
+        if transitioned:
+            flight_emit("health.degraded", tracker=self.name,
+                        error=type(exc).__name__)
 
     def note_success(self) -> None:
         """Record recovery: state -> ready (no-op while already ready,
         so steady-state success never grows the transition history)."""
+        transitioned = False
         with self._lock:
             if self._state != "ready":
                 self._state = "ready"
                 self._transitions.append(
                     {"state": "ready",
                      "t_monotonic": round(time.monotonic(), 3)})
+                transitioned = True
+        if transitioned:
+            flight_emit("health.ready", tracker=self.name)
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable ``{"state", "last_error", "transitions"}``
@@ -73,3 +131,19 @@ class HealthTracker:
                                if self._last_error else None),
                 "transitions": list(self._transitions),
             }
+
+    def payload(self, *, live: bool,
+                state_override: Optional[str] = None,
+                **extra: Any) -> Dict[str, Any]:
+        """The tracker's state rendered through :func:`health_payload`.
+        ``state_override`` replaces the tracker's own state (the
+        owner's breaker-open/lag/closed layering); extras ride through
+        verbatim."""
+        snap = self.snapshot()
+        return health_payload(
+            live=live,
+            state=state_override if state_override is not None
+            else snap["state"],
+            last_error=snap["last_error"],
+            transitions=snap["transitions"],
+            **extra)

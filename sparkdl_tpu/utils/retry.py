@@ -15,6 +15,7 @@ import random
 import time
 from typing import Any, Callable, Optional, Sequence, Tuple, Type
 
+from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -84,6 +85,8 @@ def with_retries(fn: Callable[[], Any], *, max_retries: int = 2,
                 break
             logger.warning("attempt %d/%d failed (%s: %s); retrying",
                            attempt + 1, attempts, type(e).__name__, e)
+            flight_emit("retry.attempt", attempt=attempt + 1,
+                        of=attempts, error=type(e).__name__)
             if on_retry is not None:
                 on_retry(attempt, e)
             if backoff_seconds:

@@ -1,0 +1,747 @@
+"""Flight recorder + SLO burn-rate engine tests (ISSUE 9).
+
+Contracts pinned here:
+  * the ``SPARKDL_BLACKBOX`` gate and the near-zero DISABLED path
+    (module-global read, no recorder allocated);
+  * the bounded event ring: catalog-validated names, monotonic ``seq``,
+    oldest-first eviction, wall + monotonic stamps, trace-id capture;
+  * durability: incremental fsync'd dumps (each event on disk exactly
+    once across triggers), the explicit-path full export, the
+    ready->degraded synchronous dump, the SIGTERM dump, and the SIGKILL
+    crash test — a child dies mid-incident and the recovered dump is
+    valid JSONL (shared ``recover_jsonl``) holding the pre-kill
+    breaker/health events;
+  * the SLO engine: declarative objective validation, availability
+    burn-rate math that flips breach at the EXACT synthetic crossing,
+    the two-window guard (long window ignores blips, short window ends
+    the episode), latency/lag kinds, HealthTracker degradation with
+    ``SLOViolation`` in ``last_error``, and the ``slos=`` wiring in
+    ``Server``/``StreamScorer`` ``health()``;
+  * the unified ``health()`` payload schema (``utils.health.
+    health_payload``) spoken by all three surfaces — Server, Fleet,
+    StreamScorer — as one contract;
+  * graftlint SDL008: ``flight_emit``/``flight.emit`` literals must
+    exist in the ``EVENT_HELP`` catalog (static half of
+    ``validate_event``), with the ast-read registry matching runtime;
+  * ``tools/blackbox.py``: timeline document schema, exit codes, and
+    THE acceptance chaos — breaker trip mid-rollout + stream stall
+    reconstructed as the full causal chain, trace-id-correlated with
+    the span JSONL, deterministic across two seeded runs.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import faults, obs
+from sparkdl_tpu.faults import FaultPlan
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs.flight import FlightRecorder
+from sparkdl_tpu.obs.slo import SLO, SLOEngine, SLOViolation, slo_snapshot
+from sparkdl_tpu.utils.health import (HEALTH_STATES, HealthTracker,
+                                      health_payload)
+from sparkdl_tpu.utils.jsonl import read_jsonl, recover_jsonl
+from sparkdl_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _restore_flight():
+    """Every test leaves the process recorder (and tracer) the way the
+    environment configures them (disabled in the test env)."""
+    yield
+    r = flight.get_recorder()
+    if r is not None:
+        r.close()
+    flight.configure_from_env()
+    obs.configure_from_env()
+
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"])
+
+
+# -- catalog + ring --------------------------------------------------------
+
+def test_event_catalog_shape_and_validate():
+    assert flight.EVENTS == tuple(flight.EVENT_HELP)
+    for name, help_s in flight.EVENT_HELP.items():
+        assert name == name.lower() and "." in name, name
+        assert isinstance(help_s, str) and help_s
+        assert flight.validate_event(name) == name
+    with pytest.raises(ValueError, match="unknown flight event"):
+        flight.validate_event("breaker.opne")
+
+
+def test_disabled_by_default_and_off_path():
+    """SPARKDL_BLACKBOX unset: no recorder exists and emit is a no-op
+    returning None — the near-zero path the overhead guard times."""
+    flight.configure_from_env()
+    assert flight.get_recorder() is None
+    assert flight.emit("breaker.open", error="X") is None
+
+
+def test_ring_bounded_seq_monotonic_and_snapshot_copies():
+    rec = flight.configure(enabled=True, capacity=4)
+    for i in range(6):
+        rec.record("retry.attempt", {"attempt": i})
+    assert len(rec) == 4
+    snap = rec.snapshot()
+    # oldest evicted first: attempts 2..5 survive, seq strictly rises
+    assert [e["attrs"]["attempt"] for e in snap] == [2, 3, 4, 5]
+    assert [e["seq"] for e in snap] == sorted(e["seq"] for e in snap)
+    for e in snap:
+        assert e["pid"] == os.getpid()
+        assert e["t_wall"] > 0 and e["t_mono"] > 0
+        assert e["trace_id"] is None  # tracing off in the test env
+    snap[0]["event"] = "mutated"  # copies: the ring is not aliased
+    assert rec.snapshot()[0]["event"] == "retry.attempt"
+    with pytest.raises(ValueError, match="unknown flight event"):
+        rec.record("not.registered")
+    # non-scalar attrs are stringified at emit time (always serializable)
+    ev = rec.record("fault.fired", {"error": RuntimeError("boom")})
+    json.dumps(ev)
+    assert "boom" in ev["attrs"]["error"]
+
+
+def test_blackbox_env_grammar(monkeypatch):
+    for raw, want in [("", (False, None)), ("0", (False, None)),
+                      ("off", (False, None)), ("1", (True, None)),
+                      ("true", (True, None)),
+                      ("/tmp/bb", (True, "/tmp/bb"))]:
+        monkeypatch.setenv("SPARKDL_BLACKBOX", raw)
+        assert flight.blackbox_from_env() == want
+
+
+def test_emit_captures_active_trace_id(tmp_path):
+    flight.configure(enabled=True)
+    obs.configure(enabled=True)
+    tracer = obs.get_tracer()
+    span = tracer.start_span("serving.request")
+    with tracer.use(span):
+        ev = flight.emit("serving.shed", reason="queue_full")
+    span.finish()
+    assert ev["trace_id"] == span.trace_id
+
+
+# -- durability ------------------------------------------------------------
+
+def test_incremental_dump_each_event_once_and_explicit_export(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    rec.record("breaker.open", {"consecutive": 3})
+    rec.record("serving.shed", {"reason": "queue_full"})
+    p = rec.dump()
+    assert p and os.path.basename(p) == f"flight_{os.getpid()}.jsonl"
+    rec.record("breaker.close")
+    assert rec.dump() == p
+    events = flight.load_flight(p)
+    assert [e["event"] for e in events] == [
+        "breaker.open", "serving.shed", "breaker.close"]  # no dupes
+    # explicit path: full-snapshot export (truncating one-off copy)
+    exp = str(tmp_path / "export.jsonl")
+    assert rec.dump(exp) == exp
+    assert [e["event"] for e in flight.load_flight(exp)] == [
+        "breaker.open", "serving.shed", "breaker.close"]
+    rec.close()
+
+
+def test_degraded_transition_triggers_durable_dump(tmp_path):
+    """ready->degraded is the synchronous dump trigger: the moment the
+    next instants stop being trustworthy, the past is already on disk."""
+    flight.configure(enabled=True, out_dir=str(tmp_path))
+    t = HealthTracker("serving.health")
+    t.note_failure(RuntimeError("device dead"))
+    files = glob.glob(str(tmp_path / "flight_*.jsonl"))
+    assert len(files) == 1  # no explicit dump() call was made
+    events = flight.load_flight(files[0])
+    assert events[-1]["event"] == "health.degraded"
+    assert events[-1]["attrs"]["tracker"] == "serving.health"
+    t.note_success()  # ready: recorded in the ring, not a dump trigger
+    names = [e["event"] for e in flight.get_recorder().snapshot()]
+    assert names == ["health.degraded", "health.ready"]
+
+
+def test_sigkill_mid_incident_dump_recovers(tmp_path):
+    """ISSUE 9 satellite: a child SIGKILLs itself mid-incident (torn
+    write in flight) and the recovered dump is valid JSONL — the shared
+    ``recover_jsonl`` path — containing the pre-kill breaker/health
+    events."""
+    child = r"""
+import os, signal
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.utils.health import HealthTracker
+
+flight.emit("breaker.open", consecutive=2, error="InjectedDeadDeviceError")
+t = HealthTracker("serving.health")
+t.note_failure(RuntimeError("device dead mid-incident"))  # durable dump
+# tear the tail exactly as a crash mid-append would, then die for real
+with open(flight.get_recorder().dump(), "ab") as fh:
+    fh.write(b'{"seq": 999, "event": "health.re')
+    fh.flush(); os.fsync(fh.fileno())
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    env = dict(os.environ, SPARKDL_BLACKBOX=str(tmp_path))
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          cwd=REPO, capture_output=True, timeout=60)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    files = glob.glob(str(tmp_path / "flight_*.jsonl"))
+    assert len(files) == 1
+    records, discarded = recover_jsonl(files[0])
+    assert discarded > 0  # the torn tail was really there and truncated
+    assert [r["event"] for r in records] == ["breaker.open",
+                                             "health.degraded"]
+    assert records[1]["attrs"]["tracker"] == "serving.health"
+    clean, _ = read_jsonl(files[0])  # post-recovery file parses whole
+    assert clean == records
+
+
+def test_sigterm_dumps_before_termination(tmp_path):
+    """SIGTERM: dump, then die of the signal (default disposition
+    re-raised) — no degraded transition needed for durability."""
+    child = r"""
+import os, signal
+from sparkdl_tpu.obs import flight
+
+flight.emit("breaker.open", error="X")
+flight.emit("serving.shed", reason="queue_full")
+os.kill(os.getpid(), signal.SIGTERM)
+"""
+    env = dict(os.environ, SPARKDL_BLACKBOX=str(tmp_path))
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          cwd=REPO, capture_output=True, timeout=60)
+    assert proc.returncode == -signal.SIGTERM, proc.stderr.decode()
+    files = glob.glob(str(tmp_path / "flight_*.jsonl"))
+    assert len(files) == 1
+    assert [e["event"] for e in flight.load_flight(files[0])] == [
+        "breaker.open", "serving.shed"]
+
+
+# -- SLO engine ------------------------------------------------------------
+
+def test_slo_declaration_validation():
+    with pytest.raises(ValueError, match="kind"):
+        SLO("x", "throughput")
+    with pytest.raises(ValueError, match="good="):
+        SLO("x", "availability", objective=0.99)
+    with pytest.raises(ValueError, match="objective"):
+        SLO("x", "availability", good="g", total="t", objective=1.5)
+    with pytest.raises(ValueError, match="threshold_ms"):
+        SLO("x", "latency", series="s")
+    with pytest.raises(ValueError, match="gauge="):
+        SLO("x", "lag", threshold_s=30.0)
+    with pytest.raises(TypeError, match="SLO instances"):
+        SLOEngine(Metrics(), [{"name": "x"}])
+    slo = SLO("avail", "availability", good="g", total="t",
+              objective=0.999)
+    assert slo.burn_threshold == 14.4  # the fast-burn page default
+    assert slo.as_dict()["objective"] == 0.999
+
+
+def test_availability_burn_flips_at_exact_crossing():
+    """THE chip-free SLO determinism guard: with synthetic clocks and
+    counters, the breach flips exactly when the windowed burn rate
+    reaches ``burn_threshold`` — 1.9 holds, 2.0 flips — and degrades
+    the attached HealthTracker naming the objective."""
+    m = Metrics()
+    health = HealthTracker("slo.test.health", name="slo-owner")
+    eng = SLOEngine(
+        m, [SLO("avail", "availability", good="ok", total="all",
+                objective=0.9, burn_threshold=2.0)],
+        health=health, short_window_s=5.0, long_window_s=300.0)
+    flight.configure(enabled=True)
+    assert eng.evaluate(now=0.0)["state"] == "ok"  # baseline, no traffic
+    m.incr("all", 100.0)
+    m.incr("ok", 81.0)   # bad 19% -> burn 1.9: UNDER threshold 2.0
+    out = eng.evaluate(now=10.0)
+    assert out["state"] == "ok"
+    assert out["objectives"][0]["burn_short"] == pytest.approx(1.9)
+    assert health.snapshot()["state"] == "ready"
+    m.incr("all", 100.0)
+    m.incr("ok", 79.0)   # cumulative bad 40/200 = 20% -> long burn 2.0
+    out = eng.evaluate(now=20.0)
+    # the LONG window (baseline: the t=0 zero sample) crosses at
+    # EXACTLY threshold (>= is a breach); the short window (baseline:
+    # the t=10 sample) burns 21/100 -> 2.1 — both at/over: breach
+    assert out["state"] == "breach"
+    assert out["objectives"][0]["burn_long"] == pytest.approx(2.0)
+    assert out["objectives"][0]["burn_short"] == pytest.approx(2.1)
+    assert out["objectives"][0]["burn"] == pytest.approx(2.1)
+    snap = health.snapshot()
+    assert snap["state"] == "degraded"
+    assert snap["last_error"]["type"] == "SLOViolation"
+    assert "avail" in snap["last_error"]["error"]
+    # recovery: the SHORT window (5 s -> baseline = the t=20 sample)
+    # sees clean traffic only and ends the episode
+    m.incr("all", 100.0)
+    m.incr("ok", 100.0)
+    out = eng.evaluate(now=26.0)
+    assert out["state"] == "ok"
+    assert health.snapshot()["state"] == "ready"
+    names = [e["event"] for e in flight.get_recorder().snapshot()
+             if e["event"].startswith("slo.")]
+    assert names == ["slo.breach", "slo.recovered"]
+
+
+def test_two_window_guard_long_window_ignores_blips():
+    """A short error blip burns the SHORT window hot while the LONG
+    window stays under threshold -> no breach (the classic guard)."""
+    m = Metrics()
+    eng = SLOEngine(
+        m, [SLO("avail", "availability", good="ok", total="all",
+                objective=0.9, burn_threshold=2.0)],
+        short_window_s=5.0, long_window_s=300.0)
+    eng.evaluate(now=0.0)         # zero baseline
+    m.incr("all", 1000.0)
+    m.incr("ok", 1000.0)          # long history of clean traffic
+    eng.evaluate(now=100.0)
+    m.incr("all", 10.0)
+    m.incr("ok", 5.0)             # blip: 50% bad over the short window
+    out = eng.evaluate(now=304.0)
+    st = out["objectives"][0]
+    assert st["burn_short"] == pytest.approx(5.0)       # blazing
+    assert st["burn_long"] == pytest.approx(5.0 / 1010 / 0.1, rel=1e-2)
+    assert out["state"] == "ok"  # the long window refused the page
+
+
+def test_slo_recovery_never_clears_unrelated_degradation():
+    """An SLO recovery calls note_success only while the tracker's
+    last_error is still the SLO's own violation — a dispatch failure
+    that degraded the tracker AFTER the breach keeps its 'no success
+    since' episode until a real success."""
+    m = Metrics()
+    health = HealthTracker("slo.test.health", name="t")
+    eng = SLOEngine(
+        m, [SLO("avail", "availability", good="ok", total="all",
+                objective=0.9, burn_threshold=1.0)],
+        health=health, short_window_s=5.0, long_window_s=5.0)
+    eng.evaluate(now=0.0)
+    m.incr("all", 10.0)            # 100% bad -> breach
+    eng.evaluate(now=10.0)
+    assert health.snapshot()["last_error"]["type"] == "SLOViolation"
+    # an unrelated failure lands while the SLO is still breaching
+    health.note_failure(RuntimeError("device died"))
+    m.incr("all", 100.0)
+    m.incr("ok", 100.0)            # clean traffic -> objective recovers
+    out = eng.evaluate(now=20.0)
+    assert out["state"] == "ok"
+    snap = health.snapshot()
+    assert snap["state"] == "degraded"          # episode survives
+    assert snap["last_error"]["type"] == "RuntimeError"
+    health.note_success()                       # only a REAL success ends it
+    assert health.snapshot()["state"] == "ready"
+
+
+def test_latency_and_lag_burn_kinds():
+    m = Metrics()
+    for v in [0.05] * 9 + [0.199]:
+        m.record_time("serving.request_latency", v)
+    eng = SLOEngine(m, [SLO("p99", "latency",
+                            series="serving.request_latency",
+                            threshold_ms=200.0)])
+    st = eng.evaluate(now=1.0)["objectives"][0]
+    assert st["state"] == "ok" and st["burn"] < 1.0
+    m.record_time("serving.request_latency", 0.400)  # p99 over budget
+    st = eng.evaluate(now=2.0)["objectives"][0]
+    assert st["state"] == "breach" and st["burn"] >= 1.0
+
+    m2 = Metrics()
+    eng2 = SLOEngine(m2, [SLO("lag", "lag", gauge="stream.lag_seconds",
+                              threshold_s=30.0)])
+    st = eng2.evaluate(now=1.0)["objectives"][0]
+    assert st["state"] == "ok" and st["burn"] is None  # no gauge yet
+    m2.gauge("stream.lag_seconds", 29.9)
+    assert eng2.evaluate(now=2.0)["objectives"][0]["state"] == "ok"
+    m2.gauge("stream.lag_seconds", 30.0)  # the exact crossing again
+    st = eng2.evaluate(now=3.0)["objectives"][0]
+    assert st["state"] == "breach" and st["burn"] == pytest.approx(1.0)
+
+
+def test_default_objectives_and_bench_slo_snapshot():
+    m = Metrics()
+    assert slo_snapshot(m) is None  # nothing recorded -> no verdict
+    m.incr("serving.requests", 10.0)
+    m.incr("serving.completed", 10.0)
+    m.record_time("serving.request_latency", 0.01)
+    snap = slo_snapshot(m)
+    assert snap["state"] == "ok"
+    assert {o["name"] for o in snap["objectives"]} == {
+        "serving-availability", "serving-p99-latency"}
+    json.dumps(snap)  # the bench rider must always serialize
+    m.incr("serving.requests", 10.0)   # 10 new requests, none complete
+    assert slo_snapshot(m)["state"] == "breach"
+    m2 = Metrics()
+    m2.incr("fleet.requests", 5.0)
+    m2.incr("fleet.completed", 5.0)
+    m2.incr("stream.chunks", 3.0)
+    m2.incr("stream.commits", 3.0)
+    m2.gauge("stream.lag_seconds", 0.5)
+    names = {o["name"] for o in slo_snapshot(m2)["objectives"]}
+    assert names == {"fleet-availability", "stream-commit-availability",
+                     "stream-watermark-lag"}
+
+
+def test_server_health_slo_wiring(tmp_path):
+    """``Server(slos=[...])``: every health() poll takes one burn-rate
+    sample; a breach degrades the server's own tracker and the
+    evaluation rides ``health()["slo"]``."""
+    from sparkdl_tpu.serving import Server
+
+    rng = np.random.default_rng(5)
+    w = {"w": rng.normal(size=(12, 5)).astype(np.float32)}
+    x = rng.normal(size=(12,)).astype(np.float32)
+    with Server(_fn, w, max_batch_size=8, max_wait_ms=1,
+                bucket_sizes=[8],
+                slos=[SLO("p99", "latency",
+                          series="serving.request_latency",
+                          threshold_ms=1e-6)]) as srv:
+        h = srv.health()
+        assert h["slo"]["state"] == "ok"  # no traffic yet: no verdict
+        np.asarray(srv.predict(x))        # any real latency breaches
+        h = srv.health()
+        assert h["slo"]["state"] == "breach"
+        assert h["state"] == "degraded"
+        assert h["last_error"]["type"] == "SLOViolation"
+        json.dumps(srv.varz())
+
+
+def test_stream_health_slo_wiring(tmp_path):
+    from sparkdl_tpu import streaming
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+
+    rng = np.random.default_rng(6)
+    eng = InferenceEngine(_fn, {"w": rng.normal(size=(8, 4)).astype(
+        np.float32)}, device_batch_size=8)
+    sc = streaming.StreamScorer(
+        eng, streaming.MemorySource([], finished=True),
+        journal_path=str(tmp_path / "j.jsonl"),
+        out_dir=str(tmp_path / "out"),
+        slos=[SLO("lag", "lag", gauge="stream.lag_seconds",
+                  threshold_s=30.0)])
+    assert sc.health()["slo"]["objectives"][0]["state"] == "ok"
+    sc.metrics.gauge("stream.lag_seconds", 31.0)
+    h = sc.health()
+    assert h["slo"]["state"] == "breach"
+    assert h["state"] == "degraded"
+    assert h["last_error"]["type"] == "SLOViolation"
+
+
+# -- unified health contract (satellite) -----------------------------------
+
+def test_health_payload_schema_guards():
+    p = health_payload(live=True, state="ready", breaker={})
+    assert list(p)[:4] == ["live", "state", "last_error", "transitions"]
+    with pytest.raises(ValueError, match="health state"):
+        health_payload(live=True, state="sideways")
+
+
+def test_health_contract_shared_by_all_three_surfaces(tmp_path):
+    """The one schema ``blackbox`` parses: Server, Fleet, and
+    StreamScorer all build health() through ``HealthTracker.payload``
+    — same core keys, same state vocabulary, JSON-serializable."""
+    from sparkdl_tpu import streaming
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+    from sparkdl_tpu.serving import Fleet, Server
+
+    rng = np.random.default_rng(7)
+    w = {"w": rng.normal(size=(12, 5)).astype(np.float32)}
+    payloads = {}
+    with Server(_fn, w, max_batch_size=8, max_wait_ms=1,
+                bucket_sizes=[8]) as srv:
+        payloads["server"] = srv.health()
+    with Fleet(max_batch_size=8, max_wait_ms=1, bucket_sizes=[8]) as fl:
+        fl.add_model("m", _fn, w)
+        payloads["fleet"] = fl.health()
+    eng = InferenceEngine(_fn, w, device_batch_size=8)
+    sc = streaming.StreamScorer(
+        eng, streaming.MemorySource([], finished=True),
+        journal_path=str(tmp_path / "j.jsonl"),
+        out_dir=str(tmp_path / "out"))
+    payloads["stream"] = sc.health()
+    for surface, h in payloads.items():
+        assert list(h)[:4] == ["live", "state", "last_error",
+                               "transitions"], surface
+        assert h["state"] in HEALTH_STATES, surface
+        assert isinstance(h["live"], bool), surface
+        assert isinstance(h["transitions"], list) and h["transitions"]
+        for tr in h["transitions"]:
+            assert set(tr) == {"state", "t_monotonic"}, surface
+        json.dumps(h)
+    # the surface extras still ride along, outside the core contract
+    assert "breaker" in payloads["server"]
+    assert "models" in payloads["fleet"]
+    assert {"watermark", "lag_s", "source_exhausted"} <= set(
+        payloads["stream"])
+
+
+# -- graftlint SDL008 ------------------------------------------------------
+
+def test_sdl008_unknown_event_flagged_known_clean():
+    from sparkdl_tpu.analysis import lint_source
+
+    events = {"breaker.open", "serving.shed"}
+    bad = 'flight_emit("breaker.opne", error="x")\n'
+    found = lint_source(bad, events=events)
+    assert [f.code for f in found] == ["SDL008"]
+    assert "breaker.opne" in found[0].message
+    ok = ('flight_emit("breaker.open")\n'
+          'flight.emit("serving.shed", reason="full")\n')
+    assert lint_source(ok, events=events) == []
+    # dynamic names are the runtime half's job (validate_event)
+    assert lint_source("flight_emit(name)\n", events=events) == []
+    # an unrelated emit() spelling is never claimed
+    assert lint_source('emit("config", "m", 1.0, "u")\n',
+                       events=events) == []
+
+
+def test_sdl008_missing_catalog_and_pragma():
+    from sparkdl_tpu.analysis import lint_source
+
+    found = lint_source('flight_emit("breaker.open")\n', events=None)
+    assert [f.code for f in found] == ["SDL008"]
+    assert "no catalog" in found[0].message
+    suppressed = ('flight_emit("not.yet.registered")  '
+                  '# graftlint: allow=SDL008 reason=staged rollout\n')
+    assert lint_source(suppressed, events={"breaker.open"}) == []
+
+
+def test_sdl008_registry_loader_matches_runtime():
+    """The ast-read catalog (what the linter checks against) and the
+    runtime EVENTS tuple (what validate_event enforces) can never
+    drift — same file, both halves pinned equal here."""
+    from sparkdl_tpu.analysis import (load_event_registry,
+                                      load_event_registry_file)
+
+    path = os.path.join(REPO, "sparkdl_tpu", "obs", "flight.py")
+    assert load_event_registry_file(path) == set(flight.EVENTS)
+    assert load_event_registry([os.path.join(REPO, "sparkdl_tpu")]) == \
+        set(flight.EVENTS)
+
+
+def test_graftlint_cli_events_file(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text('flight_emit("breaker.opne")\n')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         str(bad), "--events-file",
+         os.path.join(REPO, "sparkdl_tpu", "obs", "flight.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "SDL008" in proc.stdout
+
+
+# -- blackbox --------------------------------------------------------------
+
+def _write_dump(path, events):
+    rec = FlightRecorder()
+    for name, attrs in events:
+        rec.record(name, attrs)
+    rec.dump(path)
+
+
+def test_blackbox_document_and_exit_codes(tmp_path):
+    from tools.blackbox import build_timeline, main
+
+    clean = str(tmp_path / "clean.jsonl")
+    _write_dump(clean, [
+        ("fault.fired", {"site": "engine.dispatch"}),
+        ("health.degraded", {"tracker": "serving.health"}),
+        ("health.ready", {"tracker": "serving.health"}),
+    ])
+    doc = build_timeline(clean)
+    assert doc["chain"] == ["fault.fired", "health.degraded",
+                            "health.ready"]
+    assert doc["health"] == {"serving.health": "ready"}
+    assert doc["verdict"]["clean"] is True
+    assert doc["events"][0]["rel_s"] == 0.0
+    json.dumps(doc)
+    assert main([clean]) == 0
+
+    unresolved = str(tmp_path / "unresolved.jsonl")
+    _write_dump(unresolved, [
+        ("breaker.open", {"error": "X"}),
+        ("health.degraded", {"tracker": "serving.health"}),
+    ])
+    assert main([unresolved]) == 1  # a tracker never recovered
+
+    # --json CLI on a directory of dumps + a bench artifact fold
+    bench_lines = tmp_path / "bench_lines.jsonl"
+    bench_lines.write_text(json.dumps(
+        {"config": "serving", "metric": "m", "faults": "none",
+         "slo": {"state": "ok", "objectives": []}}) + "\n")
+    bb_dir = tmp_path / "dumps"
+    bb_dir.mkdir()
+    _write_dump(str(bb_dir / "flight_1.jsonl"), [
+        ("health.degraded", {"tracker": "t"}),
+        ("health.ready", {"tracker": "t"})])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "blackbox.py"),
+         str(bb_dir), "--bench", str(bench_lines), "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["bench"] == [{"config": "serving", "metric": "m",
+                             "faults": "none", "slo": "ok"}]
+    assert proc.returncode == 0
+
+
+def test_blackbox_corrupt_input_exit_2(tmp_path):
+    from tools.blackbox import main
+
+    bad = tmp_path / "corrupt.jsonl"
+    bad.write_text('{"seq": 1, "event": "breaker.open"}\n'
+                   'not json at all\n'
+                   '{"seq": 2, "event": "breaker.close"}\n')
+    assert main([str(bad)]) == 2  # mid-file damage is not a torn tail
+
+
+# -- THE acceptance chaos --------------------------------------------------
+
+def _is_subsequence(needle, haystack):
+    it = iter(haystack)
+    return all(any(h == n for h in it) for n in needle)
+
+
+_CAUSAL = ("rollout.start", "fault.fired", "retry.attempt",
+           "breaker.open", "fleet.shed", "stream.stall",
+           "stream.stall_recovered", "breaker.half_open",
+           "breaker.close", "rollout.promote")
+
+
+def _run_incident(base_dir):
+    """One seeded incident: breaker trip mid-rollout + stream stall,
+    everything recovered; returns the blackbox timeline document."""
+    from sparkdl_tpu import streaming
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+    from sparkdl_tpu.serving import Fleet
+    from sparkdl_tpu.serving.errors import ServiceUnavailableError
+    from tools.blackbox import build_timeline
+
+    bb_dir = os.path.join(base_dir, "blackbox")
+    tr_dir = os.path.join(base_dir, "trace")
+    flight.configure(enabled=True, out_dir=bb_dir)
+    obs.configure(enabled=True, out_dir=tr_dir)
+    rng = np.random.default_rng(17)
+    w1 = {"w": rng.normal(size=(12, 5)).astype(np.float32)}
+    w2 = {"w": rng.normal(size=(12, 5)).astype(np.float32)}
+    x = rng.normal(size=(12,)).astype(np.float32)
+    plan = FaultPlan.parse(
+        "seed=9;engine.dispatch:error:exc=dead,every=1,times=2")
+    with Fleet(max_batch_size=8, max_wait_ms=1, bucket_sizes=[8],
+               dispatch_retries=1, breaker_threshold=2,
+               breaker_cooldown_s=0.5) as fleet:
+        fleet.add_model("m", _fn, w1, warm_example=x)
+        fleet.add_version("m", w2)
+        fleet.start_rollout("m", canary_fraction=0.5, warm_example=x)
+        with faults.active(plan):
+            # 1: the injected dead device eats the dispatch AND its one
+            # retry -> breaker opens at threshold 2, request fails
+            fut1 = fleet.submit("m", x)
+            assert fut1.exception(timeout=30) is not None
+            # 2: next two submissions alternate servers — the one routed
+            # to the broken leg is shed at admission (breaker open)
+            shed = 0
+            for _ in range(2):
+                try:
+                    fleet.submit("m", x).result(timeout=30)
+                except ServiceUnavailableError:
+                    shed += 1
+            assert shed == 1
+            # 3: mid-incident the stream source goes silent past its
+            # watchdog deadline, then recovers
+            eng = InferenceEngine(
+                _fn, w1, device_batch_size=8,
+                metrics=Metrics())  # keep stream metrics out of serving
+            src = streaming.MemorySource()
+            sc = streaming.StreamScorer(
+                eng, src, journal_path=os.path.join(base_dir, "j.jsonl"),
+                out_dir=os.path.join(base_dir, "out"),
+                stall_deadline_s=0.05, poll_backoff_s=0.005,
+                pipeline=False)
+            worker = threading.Thread(target=sc.run, daemon=True)
+            worker.start()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                names = [e["event"]
+                         for e in flight.get_recorder().snapshot()]
+                if "stream.stall" in names:
+                    break
+                time.sleep(0.005)
+            assert "stream.stall" in names, names
+            src.feed(rng.normal(size=(8, 12)).astype(np.float32))
+            src.finish()
+            worker.join(timeout=30)
+            assert not worker.is_alive()
+            # 4: cool-down elapses; the trial dispatch closes the
+            # breaker and serving recovers
+            time.sleep(0.7)
+            for _ in range(2):
+                fleet.submit("m", x).result(timeout=30)
+            # 5: the rollout this all happened inside completes
+            fleet.promote("m")
+        assert fleet.health()["state"] == "ready"
+    obs.get_tracer().flush()
+    flight.get_recorder().dump()
+    return build_timeline(bb_dir, spans_path=tr_dir,
+                          journal_path=os.path.join(base_dir, "j.jsonl"))
+
+
+@pytest.mark.filterwarnings("ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_chaos_blackbox_reconstructs_causal_chain(tmp_path):
+    """ISSUE 9 acceptance: under injected faults (breaker trip
+    mid-rollout + stream stall), ``tools/blackbox.py`` reconstructs
+    from the durable dump a timeline containing the full causal chain
+    — fault fired -> retries exhausted -> breaker open -> shed ->
+    degraded -> half-open -> ready — in order, trace-id-correlated
+    with the span JSONL, deterministic across two seeded runs."""
+    doc1 = _run_incident(str(tmp_path / "run1"))
+    doc2 = _run_incident(str(tmp_path / "run2"))
+    for doc in (doc1, doc2):
+        chain = doc["chain"]
+        assert _is_subsequence(
+            ["fault.fired", "retry.attempt", "breaker.open",
+             "fleet.shed", "health.degraded", "breaker.half_open",
+             "health.ready"], chain), chain
+        # the trip really happened MID-rollout
+        assert chain.index("rollout.start") < chain.index("fault.fired")
+        assert (chain.index("breaker.close")
+                < chain.index("rollout.promote"))
+        # the stream's own stall/recovery episode is on the timeline
+        assert _is_subsequence(
+            ["stream.stall", "health.degraded", "stream.stall_recovered",
+             "health.ready", "stream.commit"], chain), chain
+        assert doc["counts"]["fault.fired"] == 2  # every=1,times=2 — exact
+        assert doc["counts"]["retry.attempt"] == 1
+        assert doc["counts"]["fleet.shed"] == 1
+        # every degradation recovered, the journal has no replay debt
+        assert doc["health"] == {"serving.health": "ready",
+                                 "stream.health": "ready"}
+        assert doc["verdict"]["clean"] is True, doc["verdict"]
+        assert doc["journal"]["uncommitted"] == []
+        # trace-id correlation with the span JSONL: the breaker/fault
+        # events carry the dispatching request's trace id, and those
+        # ids resolve to recorded span trees
+        assert doc["correlated_events"] >= 1
+        correlated = [e for e in doc["events"]
+                      if e["trace_known"]
+                      and e["event"] in ("fault.fired", "breaker.open",
+                                         "retry.attempt")]
+        assert correlated, "causal events lost their trace ids"
+        tid = correlated[0]["trace_id"]
+        assert doc["traces"][tid]["count"] >= 1
+    # determinism: the causal event sequence is identical run to run
+    causal1 = [(e["event"], (e.get("attrs") or {}).get("reason"))
+               for e in doc1["events"] if e["event"] in _CAUSAL]
+    causal2 = [(e["event"], (e.get("attrs") or {}).get("reason"))
+               for e in doc2["events"] if e["event"] in _CAUSAL]
+    assert causal1 == causal2

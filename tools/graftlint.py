@@ -34,6 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from sparkdl_tpu.analysis import (RULE_HELP, lint_paths,  # noqa: E402
+                                  load_event_registry_file,
                                   load_site_registry_file)
 
 
@@ -51,6 +52,10 @@ def main(argv=None) -> int:
     ap.add_argument("--sites-file", default=None,
                     help="explicit faults/sites.py to read the fault-site "
                          "registry from (default: auto-located under the "
+                         "targets)")
+    ap.add_argument("--events-file", default=None,
+                    help="explicit obs/flight.py to read the flight-event "
+                         "catalog from (default: auto-located under the "
                          "targets)")
     args = ap.parse_args(argv)
 
@@ -75,8 +80,15 @@ def main(argv=None) -> int:
             print(f"graftlint: {args.sites_file} holds no SITE_HELP/"
                   f"SITES literal", file=sys.stderr)
             return 2
+    events = None
+    if args.events_file:
+        events = load_event_registry_file(args.events_file)
+        if not events:
+            print(f"graftlint: {args.events_file} holds no EVENT_HELP/"
+                  f"EVENTS literal", file=sys.stderr)
+            return 2
 
-    findings = lint_paths(args.targets, sites=sites)
+    findings = lint_paths(args.targets, sites=sites, events=events)
     if args.as_json:
         import json
 
