@@ -309,12 +309,92 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
         slo = slo_snapshot(m)
         if slo is not None:
             rec["slo"] = slo
+    # the pad-overhead rider (ISSUE 11, the prep step ROADMAP item 2's
+    # ragged batching asks for): the GC004 pad-waste bounds from the
+    # committed PROGRAMS.lock.json (analytic, per zoo model) next to
+    # the MEASURED pad-row fraction from whatever metrics snapshot this
+    # line carries (parent registry or a subprocess child's — the
+    # engine.rows/engine.pad_rows ledger and the serving fill ratio),
+    # so every line shows what pad-to-bucket tax the run actually paid
+    # against what the lockfile says the bucket plan can cost.
+    if "pad_overhead" not in rec:
+        pad = _pad_overhead_rider(rec.get("metrics_snapshot"))
+        if pad is not None:
+            rec["pad_overhead"] = pad
     ta = _CONFIG_OBS.get("trace_artifact")
     if ta is not None and "trace_artifact" not in rec:
         rec["trace_artifact"] = ta
     line = json.dumps(rec)
     _LINES[config] = line
     _print_line(line)
+
+
+_PAD_LOCK_CACHE: list = []
+
+
+def _lockfile_pad_budgets():
+    """GC004's pad-waste view of the committed lockfile, computed once
+    per process: for each zoo model, the audited serving bucket set and
+    the analytic worst-case pad fractions — ``interior_worst_frac`` (a
+    request count one past bucket ``i`` pads to bucket ``i+1``:
+    ``(b_{i+1} - b_i - 1) / b_{i+1}``) and ``floor_frac`` (a 1-row
+    request padded to the smallest bucket).  Import-light: reads the
+    lockfile with the same stdlib-json loader bench's FLOP denominators
+    use; missing/corrupt lockfile degrades to ``{}``."""
+    if _PAD_LOCK_CACHE:
+        return _PAD_LOCK_CACHE[0]
+    budgets = {}
+    try:
+        from sparkdl_tpu.analysis.program.lockfile import (DEFAULT_LOCKFILE,
+                                                           pad_worst_fracs,
+                                                           read_lockfile)
+
+        doc = read_lockfile(DEFAULT_LOCKFILE)
+        groups = {}
+        for name, rec in doc.get("programs", {}).items():
+            model, bucket = rec.get("model"), rec.get("bucket")
+            if (name.startswith("zoo/") and rec.get("kind") == "dispatch"
+                    and model and bucket):
+                groups.setdefault(model, set()).add(int(bucket))
+        for model, buckets in sorted(groups.items()):
+            bs = sorted(buckets)
+            # the ONE GC004 formula spelling (shared with
+            # analysis.program.audit.pad_waste_audit)
+            interior, floor = pad_worst_fracs(bs)
+            budgets[model] = {
+                "buckets": bs,
+                "interior_worst_frac": round(interior, 4),
+                "floor_frac": round(floor, 4),
+            }
+    except (OSError, ValueError, KeyError):
+        budgets = {}
+    _PAD_LOCK_CACHE.append(budgets)
+    return budgets
+
+
+def _pad_overhead_rider(snapshot):
+    """The per-line ``pad_overhead`` rider: lockfile analytic bounds +
+    whatever pad accounting the line's metrics snapshot measured (the
+    engine's rows/pad_rows ledger; the serving batch fill ratio when
+    the config ran the online path).  None only when BOTH halves are
+    empty (no lockfile and no measurements)."""
+    lock = _lockfile_pad_budgets()
+    measured = {}
+    counters = (snapshot or {}).get("counters", {})
+    rows = float(counters.get("engine.rows", 0.0))
+    pad_rows = float(counters.get("engine.pad_rows", 0.0))
+    if rows + pad_rows > 0:
+        measured["rows"] = int(rows)
+        measured["pad_rows"] = int(pad_rows)
+        measured["pad_row_frac"] = round(pad_rows / (rows + pad_rows), 4)
+    fill = (snapshot or {}).get("histograms", {}).get(
+        "serving.batch_fill_ratio")
+    if fill and fill.get("count"):
+        measured["serving_fill_mean"] = fill["mean"]
+        measured["serving_pad_frac"] = round(1.0 - fill["mean"], 4)
+    if not lock and not measured:
+        return None
+    return {"lockfile": lock, "measured": measured or None}
 
 
 _RELAY_PROBE = r"""
@@ -1006,6 +1086,61 @@ def bench_pipeline():
          })
 
 
+# Content-addressed inference cache child (ISSUE 11): chip-free by
+# design, like "pipeline" — the device is a deterministic sleep, so the
+# line measures the cache/coalescing layer (digest, single-flight, LRU)
+# under a seeded Zipfian replay, the repetitive-traffic shape ROADMAP
+# item 5 names.  The line carries the analytic hit floor next to the
+# measured hit rate and the bit-identical verdict, so the speedup is
+# self-auditing.
+_CACHE_BENCH = r"""
+import json, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.serving.cache import zipfian_cache_benchmark
+out = zipfian_cache_benchmark(
+    n_requests=int(os.environ.get("SPARKDL_BENCH_CACHE_REQUESTS", "160")),
+    universe=int(os.environ.get("SPARKDL_BENCH_CACHE_UNIVERSE", "16")),
+    dispatch_ms=float(os.environ.get("SPARKDL_BENCH_CACHE_DISPATCH_MS",
+                                     "10.0")))
+print(json.dumps(out))
+"""
+
+
+def bench_cache():
+    """Content-addressed result cache + single-flight coalescing under
+    a seeded Zipfian replay on the synthetic slow device: speedup vs
+    the uncached serving path, with the measured hit rate pinned
+    against the replay's analytic floor and a bit-identical-outputs
+    verdict."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_CACHE_BENCH, timeout_s=480, env=env)
+    emit("cache",
+         "content-addressed inference cache speedup under Zipfian "
+         "replay (synthetic slow device)",
+         prof["speedup"], "x vs uncached serving path",
+         env_bound="synthetic: deterministic sleep device on host CPU "
+                   "(measures the cache/coalescing layer, not the chip)",
+         extra={
+             "n_requests": prof["n_requests"],
+             "universe": prof["universe"],
+             "zipf_s": prof["zipf_s"],
+             "hit_rate": prof["hit_rate"],
+             "analytic_hit_rate": prof["analytic_hit_rate"],
+             "uncached_s": prof["uncached_s"],
+             "cached_s": prof["cached_s"],
+             "uncached_dispatches": prof["uncached_dispatches"],
+             "cached_dispatches": prof["cached_dispatches"],
+             "bit_identical": prof["bit_identical"],
+             "cache_entries": prof["cache_entries"],
+             "cache_bytes": prof["cache_bytes"],
+         })
+
+
 # Exactly-once streaming ingestion child (ISSUE 8): chip-free by
 # design, like "pipeline" — it measures the streaming/journal layer
 # (poll -> journal intent -> pipelined score -> atomic artifact ->
@@ -1130,15 +1265,17 @@ BENCHES = {
     "fleet": bench_fleet,
     "pipeline": bench_pipeline,
     "streaming": bench_streaming,
+    "cache": bench_cache,
 }
 
 
 # Configs that never need the chip: "serving" and "fleet" run on their
 # CPU fallback (they measure the serving/fleet envelopes —
-# queue/batching/admission/swap/dispatch), "pipeline" simulates its
-# device with a deterministic sleep, and "streaming" measures the
-# journal'd crash-resume path on synthetic in-memory chunks.
-_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming")
+# queue/batching/admission/swap/dispatch), "pipeline" and "cache"
+# simulate their device with a deterministic sleep, and "streaming"
+# measures the journal'd crash-resume path on synthetic in-memory
+# chunks.
+_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming", "cache")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -1186,7 +1323,7 @@ def main():
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
-    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming"
+    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming,cache"
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

@@ -61,6 +61,12 @@ if [[ ! -f tests/test_flight.py ]]; then
        "untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_cache.py ]]; then
+  echo "FATAL: tests/test_cache.py missing — the inference-cache layer" \
+       "(single-flight coalescing, Zipfian replay benchmark, hot-swap" \
+       "survival, corruption re-check) would ship untested" >&2
+  exit 1
+fi
 if [[ ! -f tests/test_analysis.py ]]; then
   echo "FATAL: tests/test_analysis.py missing — the graftlint rules and" \
        "lock-order checker would ship untested" >&2
@@ -156,6 +162,76 @@ echo "== graftlint streaming package self-check =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/streaming \
   --sites-file sparkdl_tpu/faults/sites.py \
   --events-file sparkdl_tpu/obs/flight.py
+
+# Cache stage (ISSUE 11 satellite): re-run the cache suite with
+# SPARKDL_FAULTS carrying real cache.* rules (the tests install their
+# own plans over it, but the env gate itself is then exercised, and the
+# benign bounded sleep at cache.stampede proves a spec'd rule on the
+# single-flight leader path delays without corrupting results or
+# coalescing accounting) and SPARKDL_LOCKCHECK=1 so the new
+# serving.cache lock feeds the lock-order graph under injected
+# hit-corruption/stampede schedules.  Wall-guarded like the fleet and
+# streaming stages.
+echo "== inference-cache suite (SPARKDL_FAULTS active) =="
+SPARKDL_FAULTS="seed=4;cache.stampede:sleep:ms=1,times=2" \
+  SPARKDL_LOCKCHECK=1 \
+  timeout -k 10 300 python -m pytest tests/test_cache.py -q
+# scoped self-check, same rationale as the fleet/streaming ones: the
+# cache module must stay SDL001-SDL008 clean with no pragmas of its own
+echo "== graftlint cache module self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/serving/cache.py \
+  sparkdl_tpu/utils/digest.py \
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py
+
+# Cache-overhead guard (ISSUE 11 satellite): with SPARKDL_CACHE unset
+# the serving stack must be exactly as fast as before the cache
+# landed.  Same shape as the disabled-tracing/inject/recorder guards:
+# (a) the synthetic slow-device benchmark stays within the established
+# 1.35x sleep-math bound with no cache configured (the engine hot path
+# gained only the pad-row ledger — two counter incrs per piece); (b)
+# the disabled-path probe, serving.cache.get_default(), is one
+# module-global read + identity check within 10x a no-op and under
+# 5us, the established bar.
+echo "== cache-overhead guard =="
+env -u SPARKDL_CACHE python - <<'PY'
+import json
+import timeit
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.parallel.pipeline import synthetic_overlap_benchmark
+from sparkdl_tpu.serving import cache as serving_cache
+
+serving_cache.configure(None)  # SPARKDL_CACHE unset equivalent
+res = synthetic_overlap_benchmark()
+ideal = res["n_batches"] * max(res["prepare_ms"], res["dispatch_ms"]) / 1e3
+print(json.dumps({"ideal_s": ideal, "pipelined_s": res["pipelined_s"],
+                  "speedup": res["speedup"]}))
+assert res["pipelined_s"] <= 1.35 * ideal, (
+    f"cache-disabled pipelined wall {res['pipelined_s']:.3f}s exceeds "
+    f"1.35x the {ideal:.1f}s ideal — the SPARKDL_CACHE-unset path is "
+    f"no longer near-zero cost")
+assert res["speedup"] >= 1.5, res
+
+
+def noop():
+    return None
+
+
+n = 200_000
+t_probe = timeit.timeit(serving_cache.get_default, number=n)
+t_noop = timeit.timeit(noop, number=n)
+print(json.dumps({"probe_us": round(t_probe / n * 1e6, 3),
+                  "noop_us": round(t_noop / n * 1e6, 3)}))
+# generous bound (loaded CI hosts): the disabled default-cache probe
+# within 10x a no-op call AND under 5us absolute — the established bar
+assert t_probe / n < 5e-6 and t_probe < 10 * t_noop + 0.05, (
+    f"disabled cache probe costs {t_probe / n * 1e6:.2f}us/call "
+    f"(no-op: {t_noop / n * 1e6:.2f}us)")
+print("cache-overhead guard ok")
+PY
 
 # Tracing-overhead guard (ISSUE 3 satellite): the synthetic slow-device
 # benchmark must show that (a) DISABLED tracing (SPARKDL_TRACE=0) adds

@@ -48,6 +48,7 @@ _LAZY = {
     # serving (online inference layer; "serving" exposes the module itself)
     "serving": "sparkdl_tpu.serving",
     "Server": "sparkdl_tpu.serving",
+    "InferenceCache": "sparkdl_tpu.serving",
     # streaming (exactly-once continuous scoring; module itself + the
     # runner, mirroring the serving pair above)
     "streaming": "sparkdl_tpu.streaming",

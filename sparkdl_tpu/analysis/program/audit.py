@@ -386,15 +386,19 @@ def pad_waste_audit(records: Sequence[Dict[str, Any]],
                 f"not row-linear, so pad-to-bucket accounting (and the "
                 f"bench's FLOP-scaled baselines) are invalid"))
         buckets = [r["bucket"] for r in recs]
-        floor = (buckets[0] - 1) / buckets[0]
+        # the formulas live in lockfile.pad_gap_fracs/pad_worst_fracs,
+        # shared with bench's pad_overhead rider (ISSUE 11)
+        from sparkdl_tpu.analysis.program.lockfile import (pad_gap_fracs,
+                                                           pad_worst_fracs)
+
+        floor = pad_worst_fracs(buckets)[1]
         if floor > floor_budget:
             findings.append(Finding(
                 "GC004", f"zoo/{model}", 0,
                 f"smallest bucket {buckets[0]} pads a 1-row request to "
                 f"{floor:.0%} waste (budget {floor_budget:.0%}); add a "
                 f"smaller bucket"))
-        for prev, b in zip(buckets, buckets[1:]):
-            waste = (b - prev - 1) / b
+        for prev, b, waste in pad_gap_fracs(buckets):
             if waste > interior_budget:
                 findings.append(Finding(
                     "GC004", f"zoo/{model}", 0,

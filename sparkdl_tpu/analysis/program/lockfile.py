@@ -130,6 +130,26 @@ GC_DRIFT_HINTS = {
 }
 
 
+def pad_gap_fracs(buckets: Sequence[int]) -> List[tuple]:
+    """``[(prev, b, waste_frac)]`` per adjacent bucket pair — THE one
+    spelling of GC004's interior pad-waste formula (a request of
+    ``prev + 1`` rows served by bucket ``b`` wastes ``(b - prev - 1)/b``
+    of the program), shared by ``audit.pad_waste_audit`` (budgets it)
+    and bench.py's ``pad_overhead`` rider (stamps it) so the two can
+    never drift apart."""
+    bs = sorted(int(b) for b in buckets)
+    return [(prev, b, (b - prev - 1) / b) for prev, b in zip(bs, bs[1:])]
+
+
+def pad_worst_fracs(buckets: Sequence[int]) -> tuple:
+    """``(interior_worst, floor)`` for a bucket set: the worst adjacent
+    gap from :func:`pad_gap_fracs`, and GC004's floor formula (a 1-row
+    request padded to the smallest bucket pays ``(b0 - 1)/b0``)."""
+    bs = sorted(int(b) for b in buckets)
+    interior = max((w for _, _, w in pad_gap_fracs(bs)), default=0.0)
+    return interior, (bs[0] - 1) / bs[0]
+
+
 def zoo_gflop_per_img(path: Optional[str] = None) -> Dict[str, float]:
     """Per-model GFLOPs/image derived from the committed lockfile (the
     largest audited bucket of each zoo featurize program) — bench.py's

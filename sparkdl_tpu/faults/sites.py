@@ -28,6 +28,15 @@ SITE_HELP = {
     "pipeline.gather": "PipelinedRunner gather stage loop",
     "serving.admit": "DynamicBatcher.submit admission",
     "serving.model": "Server model-call attempt (watchdog-timed)",
+    "cache.hit": ("InferenceCache hit return path — an injected error "
+                  "corrupts the copy handed back, which the output-"
+                  "digest re-check must catch (entry invalidated, "
+                  "request re-dispatched)"),
+    "cache.stampede": ("single-flight leader dispatch window in "
+                       "Server.submit — a sleep rule holds the leader "
+                       "open so follower coalescing is observable; an "
+                       "error rule is a leader failure every follower "
+                       "must see (and that must cache nothing)"),
     "fleet.admit": "Fleet front-door admission (tenant quota/priority gate)",
     "fleet.canary": "Fleet canary routing decision during a rollout",
     "fleet.swap": "Fleet version swap attempt (rollout promote/rollback)",

@@ -30,7 +30,9 @@ InferenceEngine`` (call/dispatch spans; breaker open/half-open/close
 flight events), ``parallel.pipeline.PipelinedRunner`` (per-stage spans
 with ``block_until_ready``-bracketed device time),
 ``serving.fleet.Fleet`` (rollout start/promote/rollback + tenant-shed
-flight events), ``streaming.StreamScorer`` (``stream.run``/
+flight events), ``serving.cache.InferenceCache`` (hit/miss/coalesced/
+evict/invalidate flight events + ``cache.*`` metrics),
+``streaming.StreamScorer`` (``stream.run``/
 ``stream.chunk`` spans + stall/redelivery/commit flight events),
 ``utils.health.HealthTracker`` (ready<->degraded transition events),
 ``faults`` (``fault.fired`` per injected rule firing), ``utils.retry``

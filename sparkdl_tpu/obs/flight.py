@@ -91,6 +91,17 @@ EVENT_HELP = {
     "serving.shed": ("Server shed a request (queue full, breaker open, "
                      "or deadline expired — see attrs.reason)"),
     "serving.drain": "Server.close() began stopping/draining",
+    "cache.hit": ("inference cache served a result without an engine "
+                  "dispatch (digest re-check passed)"),
+    "cache.miss": ("inference cache miss — this request became the "
+                   "single-flight leader and pays the dispatch"),
+    "cache.coalesced": ("a request parked on an identical in-flight "
+                        "leader (zero extra dispatches)"),
+    "cache.evict": ("the bounded cache evicted an LRU entry to honor "
+                    "its entries/bytes cap"),
+    "cache.invalidate": ("cache entries dropped (hot-swap with a "
+                         "changed fingerprint, or a corrupt entry "
+                         "caught by the digest re-check)"),
     "rollout.start": "fleet canary rollout started (stable + canary live)",
     "rollout.promote": "fleet rollout promoted; old version draining",
     "rollout.rollback": "fleet rollout rolled back; canary draining",

@@ -578,8 +578,15 @@ class InferenceEngine:
         import jax
 
         n = self._leaves(chunk)
+        # pad-to-bucket ledger (ISSUE 11): real vs padded rows per
+        # dispatch piece, so the measured pad overhead GC004 budgets
+        # abstractly is observable live (`engine.pad_rows /
+        # (engine.rows + engine.pad_rows)`) and bench lines can stamp
+        # it next to the lockfile's analytic bounds
+        self.metrics.incr("engine.rows", n)
         if n == self.device_batch_size:
             return chunk
+        self.metrics.incr("engine.pad_rows", self.device_batch_size - n)
 
         def pad_leaf(a):
             pad = [(0, self.device_batch_size - n)] + [(0, 0)] * (a.ndim - 1)

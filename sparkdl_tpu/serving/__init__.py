@@ -29,10 +29,17 @@ Public surface:
   zero-downtime canary rollout with no-recompile hot-swap, per-tenant
   token-bucket quotas + priority classes (:class:`TenantQuota`,
   :class:`QuotaExceededError`), aggregated ``Fleet.varz()``/``health()``.
+* :class:`InferenceCache` (``sparkdl_tpu.serving.cache``, ISSUE 11) —
+  the content-addressed result cache + single-flight coalescing both
+  front doors (and ``StreamScorer``) probe before any queue charge:
+  bounded entries+bytes LRU keyed on ``utils.digest`` content digests,
+  N concurrent identical requests -> one dispatch, hot-swap survival
+  pinned against ``PROGRAMS.lock.json``, ``SPARKDL_CACHE`` env gate.
 """
 
 from sparkdl_tpu.serving.adapters import from_transformer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
+from sparkdl_tpu.serving.cache import InferenceCache
 from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError, QueueFullError,
                                         QuotaExceededError, ServerClosedError,
@@ -46,6 +53,7 @@ from sparkdl_tpu.serving.fleet import (Fleet, ModelRegistry, ModelVersion,
 __all__ = [
     "Server",
     "bucket_plan",
+    "InferenceCache",
     "from_transformer",
     "DynamicBatcher",
     "Request",

@@ -81,10 +81,39 @@ class Fleet:
                  default_quota: Optional[TenantQuota] = None,
                  shed_pressure: Optional[Dict[int, float]] = None,
                  slos: Optional[List[Any]] = None,
+                 cache: Any = None,
+                 program_fingerprints: Any = None,
                  metrics: Optional[Metrics] = None,
                  **server_defaults):
         self.metrics = metrics if metrics is not None else Metrics()
         self.registry = ModelRegistry()
+        # ONE result cache for the whole fleet (ISSUE 11), with
+        # per-version key namespaces ``(model, version, fingerprint)``
+        # so two versions can never serve each other's rows.  ``cache=
+        # None`` resolves the SPARKDL_CACHE process default; an
+        # explicit InferenceCache shares across fleets; ``cache=False``
+        # forces uncached.  ``program_fingerprints`` overrides how a
+        # version's StableHLO identity is resolved for the hot-swap
+        # survival rule (a ``{name: fp}`` dict or ``fn(name, entry)``);
+        # the default pins against the committed PROGRAMS.lock.json
+        # (``serving.cache.lockfile_model_fingerprint`` over the
+        # entry's zoo model), and entries with no audited programs get
+        # None — no proof, so their swaps conservatively invalidate.
+        from sparkdl_tpu.serving.cache import (resolve_cache,
+                                               unique_namespace)
+
+        self._cache = resolve_cache(cache)[0]
+        # per-fleet namespace prefix: two fleets sharing the process
+        # cache may deploy the same (name, version) with DIFFERENT
+        # weights — their entries must never collide — and the prefix
+        # makes close()'s whole-fleet reclaim safe (nobody else can
+        # reach keys under it)
+        self._cache_prefix = (unique_namespace("fleet")
+                              if self._cache is not None else ("fleet",))
+        self._program_fingerprints = program_fingerprints
+        #: (name, version) -> (program_fingerprint, weights_digest) for
+        #: deployed versions — the promote-time survival comparison
+        self._version_meta: Dict[Any, Any] = {}
         self.admission = AdmissionController(
             quotas=quotas, default_quota=default_quota,
             shed_pressure=shed_pressure)
@@ -175,7 +204,71 @@ class Fleet:
         # + f32 host cast) applies unless the caller set the knobs
         if ("compute_dtype" not in kw and "output_host_dtype" not in kw):
             kw.update(entry.engine_overrides)
+        if "cache" not in kw:
+            if self._cache is not None:
+                fp = self._resolve_fingerprint(entry)
+                from sparkdl_tpu.utils.digest import content_digest
+
+                self._version_meta[(entry.name, mv.version)] = (
+                    fp, content_digest(mv.variables))
+                kw["cache"] = self._cache
+                kw["cache_namespace"] = self._cache_prefix + (
+                    entry.name, mv.version, fp)
+            else:
+                # the fleet resolved the process default ONCE; the
+                # per-version servers must not re-resolve it behind
+                # its back
+                kw["cache"] = False
         return Server(entry.fn, variables=mv.variables, **kw)
+
+    def _resolve_fingerprint(self, entry) -> Optional[str]:
+        """The entry's committed program identity for cache survival
+        (class docstring of the ``cache=`` knob in ``__init__``)."""
+        pf = self._program_fingerprints
+        if callable(pf):
+            return pf(entry.name, entry)
+        if isinstance(pf, dict):
+            if entry.name in pf:
+                return pf[entry.name]
+        from sparkdl_tpu.serving.cache import lockfile_model_fingerprint
+
+        return lockfile_model_fingerprint(entry.model_desc)
+
+    def _swap_cache_entries(self, name: str, report: Dict[str, Any],
+                            old_version: int, new_version: int) -> tuple:
+        """The promote-time half of "cache-warm-across-swap": entries
+        SURVIVE (re-keyed under the new version's namespace) iff the
+        new version's ``PROGRAMS.lock.json`` StableHLO fingerprint is
+        unchanged — the chip-free "same computation" proof ISSUE 11
+        extends from the rollout's no-recompile contract — AND its
+        weight bytes digest-equal the old version's (the fingerprint
+        covers the program, not the weight VALUES; new weights mean
+        new outputs, so a weights rollout always invalidates).  Any
+        other promote invalidates the old namespace outright.  The
+        verdict rides the swap report as ``report["cache"]``."""
+        old_meta = self._version_meta.pop((name, old_version), None)
+        new_meta = self._version_meta.get((name, new_version))
+        old_fp, old_wd = old_meta if old_meta is not None else (None, None)
+        new_fp, new_wd = new_meta if new_meta is not None else (None, None)
+        fp_unchanged = old_fp is not None and old_fp == new_fp
+        weights_unchanged = old_wd is not None and old_wd == new_wd
+        survived = fp_unchanged and weights_unchanged
+        old_ns = self._cache_prefix + (name, old_version, old_fp)
+        if survived:
+            entries = self._cache.adopt(
+                old_ns, self._cache_prefix + (name, new_version, new_fp))
+        else:
+            entries = self._cache.invalidate(old_ns)
+        report["cache"] = {
+            "survived": survived,
+            "entries": entries,
+            "fingerprint_unchanged": fp_unchanged,
+            "weights_unchanged": weights_unchanged,
+        }
+        # the caller sweeps this namespace AGAIN after the old server's
+        # drain: in-flight old-version leaders settling during the
+        # drain re-insert under it, and nothing can ever read those
+        return old_ns
 
     # -- rollout lifecycle -------------------------------------------------
     def _state(self, name: str) -> _ModelState:
@@ -260,15 +353,30 @@ class Fleet:
             state.rollout = None
             state.last_swap_report = report
             closed = self._closed
+        old_ns = None
+        if self._cache is not None:
+            # between the phase flip above and this point v2 requests
+            # simply miss (and lead their own flights) — survival only
+            # decides whether the warm v1 entries carry over
+            old_ns = self._swap_cache_entries(name, report,
+                                              ro.stable_version,
+                                              ro.canary_version)
         self.metrics.incr("fleet.swaps")
         flight_emit("rollout.promote", model=name,
                     version=ro.canary_version,
                     drained_version=ro.stable_version,
-                    no_recompile=report.get("no_recompile"))
+                    no_recompile=report.get("no_recompile"),
+                    cache_survived=(report.get("cache") or {}).get(
+                        "survived"))
         # the old version drains OUTSIDE the state lock: new requests
         # already route to the promoted server while every in-flight v1
         # request completes on v1
         old_server.close(drain=True)
+        if self._cache is not None and old_ns is not None:
+            # post-drain sweep: leaders that settled DURING the drain
+            # re-inserted under the old namespace after the swap moved/
+            # dropped it — unreachable forever, so reclaim the bytes
+            self._cache.invalidate(old_ns)
         if closed:
             # a close() that raced the phase flip saw ro.active False,
             # skipped the canary, and closed only the old server — the
@@ -288,11 +396,28 @@ class Fleet:
         with self._lock:
             state.rollout = None
             state.last_swap_report = report
+        canary_ns = None
+        if self._cache is not None:
+            # the canary version will never serve again: its namespace
+            # is unreachable — reclaim the bytes (the stable version's
+            # entries never moved, so rollback keeps the cache warm)
+            meta = self._version_meta.pop((name, ro.canary_version), None)
+            fp = meta[0] if meta is not None else None
+            canary_ns = self._cache_prefix + (name, ro.canary_version, fp)
+            entries = self._cache.invalidate(canary_ns)
+            report["cache"] = {"survived": False, "entries": entries,
+                               "fingerprint_unchanged": None,
+                               "weights_unchanged": None}
         self.metrics.incr("fleet.rollbacks")
         flight_emit("rollout.rollback", model=name,
                     drained_version=ro.canary_version,
                     version=ro.stable_version)
         ro.canary_server.close(drain=True)
+        if self._cache is not None and canary_ns is not None:
+            # post-drain sweep, same rationale as promote(): canary
+            # leaders settling during the drain re-inserted under the
+            # dead namespace
+            self._cache.invalidate(canary_ns)
         return report
 
     def swap_report(self, name: str) -> Optional[Dict[str, Any]]:
@@ -404,6 +529,11 @@ class Fleet:
             t[key] = t.get(key, 0) + 1
 
     # -- introspection -----------------------------------------------------
+    @property
+    def cache(self):
+        """The fleet-wide result cache (None when uncached)."""
+        return self._cache
+
     def models(self) -> List[str]:
         with self._lock:
             return sorted(self._models)
@@ -506,6 +636,8 @@ class Fleet:
                 "closed": closed,
                 "models": model_section,
                 "registry": self.registry.as_dict(),
+                "cache": (self._cache.info() if self._cache is not None
+                          else None),
             },
             "health": self.health(),
             "admission": self.admission.snapshot(),
@@ -534,6 +666,14 @@ class Fleet:
             if ro is not None and ro.active:
                 ro.canary_server.close(drain=drain)
             state.server.close(drain=drain)
+        if self._cache is not None:
+            # the whole fleet prefix dies with the fleet: every
+            # per-version namespace under it is unreachable now, and
+            # leaving the entries would charge a shared/process-default
+            # cache's byte budget forever (the Server-anon reclaim
+            # rule, applied fleet-wide)
+            self._cache.invalidate(self._cache_prefix)
+            self._version_meta.clear()
         logger.info("fleet: closed (%d models)", len(models))
 
     def __enter__(self) -> "Fleet":

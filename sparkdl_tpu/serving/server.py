@@ -37,9 +37,11 @@ from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.parallel.engine import CircuitOpenError
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
-from sparkdl_tpu.serving.errors import (DispatchTimeoutError,
+from sparkdl_tpu.serving.errors import (DeadlineExceededError,
+                                        DispatchTimeoutError,
                                         ServerClosedError,
                                         ServiceUnavailableError)
+from sparkdl_tpu.utils.digest import content_digest
 from sparkdl_tpu.utils.health import HealthTracker
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
@@ -139,6 +141,50 @@ class _Once:
         self._fn()
 
 
+def _deadline_guard(inner: Future, timeout_s: float) -> Future:
+    """Caller-facing view of ``inner`` that fails with
+    ``DeadlineExceededError`` after ``timeout_s`` — how a coalesced
+    follower keeps its own deadline while parked on a leader whose
+    request may have none.
+
+    One ``threading.Timer`` per deadline-carrying follower, cancelled
+    the moment the leader settles — the same per-waiter budget as the
+    dispatch watchdog's per-attempt timer, and it exists only for the
+    flight's (typically milliseconds-long) lifetime.  A deadline wheel
+    would amortize this if stampedes of deadline-carrying identical
+    requests ever become a measured hot spot."""
+    out: Future = Future()
+
+    def _relay(f: Future) -> None:
+        timer.cancel()
+        try:
+            if f.cancelled():
+                out.cancel()
+                return
+            exc = f.exception()
+            if exc is not None:
+                out.set_exception(exc)
+            else:
+                out.set_result(f.result())
+        except InvalidStateError:  # the deadline timer fired first
+            pass
+
+    def _expire() -> None:
+        try:
+            out.set_exception(DeadlineExceededError(
+                f"coalesced request exceeded its "
+                f"{timeout_s * 1e3:.0f}ms deadline while waiting on the "
+                f"single-flight leader"))
+        except InvalidStateError:  # the leader settled first
+            pass
+
+    timer = threading.Timer(timeout_s, _expire)
+    timer.daemon = True
+    timer.start()
+    inner.add_done_callback(_relay)
+    return out
+
+
 def _settle_error(requests: Sequence[Request], exc: BaseException) -> None:
     for r in requests:
         if not r.future.done():
@@ -229,6 +275,8 @@ class Server:
                  breaker_threshold: int = 8,
                  breaker_cooldown_s: float = 30.0,
                  slos: Optional[Sequence[Any]] = None,
+                 cache: Any = None,
+                 cache_namespace: Optional[Sequence[Any]] = None,
                  metrics: Optional[Metrics] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
@@ -272,6 +320,23 @@ class Server:
 
             self._slo_engine = SLOEngine(self.metrics, slos,
                                          health=self._health)
+        # Content-addressed result cache + single-flight coalescing
+        # (ISSUE 11): probe BEFORE the admission-queue charge — a hit
+        # costs zero queue slots and zero dispatches, a coalesced
+        # follower parks on the identical in-flight leader.  ``cache=
+        # None`` (the default) resolves the SPARKDL_CACHE process
+        # default (unset env = uncached, the pre-ISSUE-11 behavior);
+        # pass an InferenceCache to share one across servers (the
+        # fleet does, with per-version namespaces) or ``cache=False``
+        # to force uncached.
+        from sparkdl_tpu.serving.cache import resolve_cache
+
+        # owned (= auto-generated anon) namespaces are reclaimed from
+        # the possibly-shared store by close() — nobody else can ever
+        # reach those keys, so leaving them would charge the byte
+        # budget until LRU pressure
+        self._cache, self._cache_ns, self._cache_ns_owned = resolve_cache(
+            cache, cache_namespace, "server")
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
         self._engine_lock = named_lock("serving.engines")
@@ -429,9 +494,126 @@ class Server:
         dispatch, so admitting more work would only convert each request
         into a slow timeout.  ``timeout_ms`` overrides the server's
         ``default_timeout_ms`` deadline.
+
+        With a result cache configured (ISSUE 11) the probe runs FIRST
+        — before the breaker shed and the admission-queue charge — so a
+        hit serves even while the device is failing (the cached row
+        needs no device), and N concurrent identical requests cost one
+        dispatch: the first becomes the single-flight leader, the rest
+        park on its future.  A leader failure settles its followers
+        with the same error and caches nothing.
         """
         if self._closed:
             raise ServerClosedError("server is closed")
+        if self._cache is not None:
+            return self._submit_cached(example, timeout_ms)
+        return self._submit_dispatch(example, timeout_ms)
+
+    def _submit_cached(self, example: Any,
+                       timeout_ms: Optional[float]) -> Future:
+        """The cache-fronted request path; see :meth:`submit`."""
+        import jax
+
+        t0 = time.monotonic()
+        if self._host_preprocess is not None:
+            example = self._host_preprocess(example)
+        example = jax.tree_util.tree_map(np.asarray, example)
+        key = self._cache_ns + (content_digest(example),)
+        kind, res = self._cache.lookup(key)
+        if kind == "hit":
+            self.metrics.incr("serving.requests")
+            self.metrics.incr("serving.completed")
+            self.metrics.incr("serving.cache_hits")
+            self.metrics.record_time("serving.request_latency",
+                                     time.monotonic() - t0)
+            fut: Future = Future()
+            fut.set_result(res)
+            return fut
+        if kind == "follower":
+            self.metrics.incr("serving.requests")
+            self.metrics.incr("serving.cache_coalesced")
+
+            def _follower_done(f: Future) -> None:
+                if not f.cancelled() and f.exception() is None:
+                    self.metrics.incr("serving.completed")
+                    self.metrics.record_time("serving.request_latency",
+                                             time.monotonic() - t0)
+
+            # a coalesced follower keeps its OWN deadline: the leader
+            # may have none, and "timeout_ms overrides the server
+            # default" must hold whether or not the request coalesced
+            timeout_s = (self._default_timeout_s if timeout_ms is None
+                         else max(0.0, timeout_ms) / 1e3)
+            caller_fut = (res if timeout_s is None
+                          else _deadline_guard(res, timeout_s))
+            # metrics ride the future the CALLER holds: a follower
+            # whose deadline guard already failed it must not count as
+            # completed (with the leader's latency) when the leader
+            # eventually settles
+            caller_fut.add_done_callback(_follower_done)
+            return caller_fut
+        flight = res
+        try:
+            # the leader's payload must be OURS: the digest above
+            # described the ORIGINAL bytes, and a caller that refills
+            # its input buffer after submit() returns would otherwise
+            # have the dispatch compute the NEW bytes' output and
+            # settle it under the OLD digest — a self-validating
+            # poisoned entry the output re-check cannot catch.
+            # O(input) copy, paid by leaders (misses) only; inside the
+            # try so even a failed copy (MemoryError) fails the flight
+            # instead of leaking it (which would park every later
+            # identical request on a future nobody resolves).
+            example = jax.tree_util.tree_map(
+                lambda a: np.array(a, copy=True), example)
+            # chaos hook: a sleep rule here holds the leader open so
+            # follower pile-up is observable; an error rule is a leader
+            # failure every follower must see (and caches nothing)
+            inject("cache.stampede")
+            fut = self._submit_dispatch(example, timeout_ms,
+                                        preprocessed=True)
+        except BaseException as e:  # noqa: BLE001 — settled to followers, re-raised
+            self._cache.fail(flight, e)
+            raise
+        # the caller gets a SEPARATE future resolved only AFTER settle
+        # has copied the row: returning the dispatch future directly
+        # would let the caller mutate its row in place concurrently
+        # with settle's copy — a torn copy would digest-validate
+        # against itself and poison every later hit
+        out: Future = Future()
+
+        def _leader_done(f: Future) -> None:
+            # settle/fail OFF the dispatch worker's completion: insert
+            # + resolve followers on success, fail them (cache
+            # untouched) on error — a poisoned result can never be
+            # stored because only a SUCCESSFUL dispatch settles
+            try:
+                value = f.result()
+            # graftlint: allow=SDL003 reason=the leader error is relayed to every follower via cache.fail and the caller future; re-raising in a done-callback would only hit the executor's swallow
+            except BaseException as e:  # noqa: BLE001
+                self._cache.fail(flight, e)
+                if not out.done():
+                    out.set_exception(e)
+            else:
+                # store=False once closed: close() already reclaimed an
+                # owned namespace, and a late-settling leader (the
+                # abandoned-wait close path) must not re-insert under
+                # it — followers still get their copies either way
+                self._cache.settle(
+                    flight, value,
+                    store=not (self._closed and self._cache_ns_owned))
+                if not out.done():
+                    out.set_result(value)
+
+        fut.add_done_callback(_leader_done)
+        return out
+
+    def _submit_dispatch(self, example: Any,
+                         timeout_ms: Optional[float],
+                         preprocessed: bool = False) -> Future:
+        """The direct dispatch path (the whole request path when no
+        cache is configured; the single-flight leader's path when one
+        is)."""
         retry_after = self._breaker_retry_after()
         if retry_after is not None:
             # count the request too: shed-rate consumers compute
@@ -445,11 +627,12 @@ class Server:
             raise ServiceUnavailableError(
                 f"dispatch circuit breaker open (device failing); "
                 f"retry in {retry_after:.2f}s", retry_after_s=retry_after)
-        if self._host_preprocess is not None:
-            example = self._host_preprocess(example)
-        import jax
+        if not preprocessed:
+            if self._host_preprocess is not None:
+                example = self._host_preprocess(example)
+            import jax
 
-        example = jax.tree_util.tree_map(np.asarray, example)
+            example = jax.tree_util.tree_map(np.asarray, example)
         timeout_s = (self._default_timeout_s if timeout_ms is None
                      else max(0.0, timeout_ms) / 1e3)
         deadline = (None if timeout_s is None
@@ -676,6 +859,16 @@ class Server:
         fleet layer sheds lowest-priority traffic against."""
         return self._batcher.depth() / max(1, self._batcher.max_queue)
 
+    @property
+    def cache(self):
+        """The result cache this server probes (None when uncached)."""
+        return self._cache
+
+    @property
+    def cache_namespace(self) -> tuple:
+        """The key prefix this server's entries live under."""
+        return self._cache_ns
+
     def executable_state(self) -> Dict[int, Dict[str, Any]]:
         """Per-bucket compiled-program identity: the ``id()`` of the
         bucket engine's shared ``jax.jit`` object and that object's
@@ -742,6 +935,8 @@ class Server:
                 "queue": dist_ms("serving.time_in_queue"),
             },
             "metrics": snap,
+            "cache": (self._cache.info() if self._cache is not None
+                      else None),
             "exemplars": self.exemplars.snapshot(),
         }
 
@@ -765,28 +960,35 @@ class Server:
         self._closed = True
         flight_emit("serving.drain", drain=drain,
                     queued=self._batcher.depth())
-        self._batcher.close(drain=drain)
-        self._dispatcher.join(timeout=timeout_s)
-        if self._dispatcher.is_alive():
-            logger.warning(
-                "close(): dispatcher still busy after %ss; abandoning — "
-                "undispatched requests fail with ServerClosedError",
-                timeout_s)
-            self._abandon.set()
-            self._dispatcher.join(timeout=5.0)
-            self._batcher.close(drain=False)  # settle anything still queued
-        deadline = (None if timeout_s is None
-                    else time.monotonic() + timeout_s)
-        with self._inflight_cond:
-            while self._inflight > 0:
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    logger.warning("close(): %d batch(es) still in flight "
-                                   "after %.1fs; abandoning wait",
-                                   self._inflight, timeout_s)
-                    return
-                self._inflight_cond.wait(remaining)
+        try:
+            self._batcher.close(drain=drain)
+            self._dispatcher.join(timeout=timeout_s)
+            if self._dispatcher.is_alive():
+                logger.warning(
+                    "close(): dispatcher still busy after %ss; abandoning "
+                    "— undispatched requests fail with ServerClosedError",
+                    timeout_s)
+                self._abandon.set()
+                self._dispatcher.join(timeout=5.0)
+                self._batcher.close(drain=False)  # settle anything queued
+            deadline = (None if timeout_s is None
+                        else time.monotonic() + timeout_s)
+            with self._inflight_cond:
+                while self._inflight > 0:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        logger.warning(
+                            "close(): %d batch(es) still in flight "
+                            "after %.1fs; abandoning wait",
+                            self._inflight, timeout_s)
+                        return
+                    self._inflight_cond.wait(remaining)
+        finally:
+            if self._cache is not None and self._cache_ns_owned:
+                # this server's anon namespace is unreachable once it
+                # is closed — reclaim the bytes from the shared store
+                self._cache.invalidate(self._cache_ns)
 
     def __enter__(self) -> "Server":
         return self

@@ -32,7 +32,6 @@ a restart replays a chunk a previous run left uncommitted.
 
 from __future__ import annotations
 
-import hashlib
 import os
 import random
 import time
@@ -47,6 +46,7 @@ from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.streaming.journal import Journal
 from sparkdl_tpu.streaming.source import Chunk, StreamSource
+from sparkdl_tpu.utils.digest import array_digest
 from sparkdl_tpu.utils.health import HealthTracker
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
@@ -61,13 +61,10 @@ class StreamStallError(RuntimeError):
     the policy is degrade + keep re-polling, not crash)."""
 
 
-def _array_digest(arr: np.ndarray) -> str:
-    a = np.ascontiguousarray(arr)
-    h = hashlib.sha256()
-    h.update(str(a.dtype).encode())
-    h.update(str(a.shape).encode())
-    h.update(a.tobytes())
-    return h.hexdigest()
+# the one digest core (utils.digest, ISSUE 11) — the journal's artifact
+# digests are byte-identical to what the local sha256 here produced
+# before the move, so pre-move journals still verify
+_array_digest = array_digest
 
 
 def _write_artifact_atomic(path: str, arr: np.ndarray) -> None:
@@ -102,6 +99,8 @@ class StreamScorer:
                  window: int = 2,
                  pipeline: Optional[bool] = None,
                  slos: Optional[Any] = None,
+                 cache: Any = None,
+                 cache_namespace: Optional[Any] = None,
                  metrics: Optional[Metrics] = None):
         if not (hasattr(sink, "map_batches") or hasattr(sink, "submit")):
             raise TypeError(
@@ -130,7 +129,25 @@ class StreamScorer:
 
             self._slo_engine = SLOEngine(self.metrics, slos,
                                          health=self._health)
+        # Result cache (ISSUE 11): a journal replay of a chunk a
+        # previous run in THIS process already scored hits the cache
+        # instead of re-dispatching (keys ride the chunk's content-
+        # addressed id — same digest core, so replay identity is free).
+        # None (the default) falls back to the SPARKDL_CACHE process
+        # default; pass an explicit InferenceCache to share one with a
+        # serving sink, or cache=False to force uncached.  An anon
+        # namespace is OWNED and reclaimed by close(); pass an explicit
+        # cache_namespace to share replay state across runner instances
+        # (the crash-resume idiom).
+        from sparkdl_tpu.serving.cache import resolve_cache
+
+        self._cache, self._cache_ns, self._cache_ns_owned = resolve_cache(
+            cache, cache_namespace, "stream")
         self._state_lock = named_lock("stream.state")
+        # serializes commits + summary accounting between the consumer
+        # thread and the delivery generator's replay short-circuit
+        # (which runs on the pipeline's prepare thread when pipelined)
+        self._commit_lock = named_lock("stream.commit_path")
         self._closed = False
         self._finished = False
         self._stalled = False
@@ -146,8 +163,13 @@ class StreamScorer:
         """Stop the run loop at the next chunk boundary (commits already
         journaled stay committed — close is not rollback)."""
         with self._state_lock:
+            first_close = not self._closed
             self._closed = True
         self._journal.close()
+        if first_close and self._cache is not None and self._cache_ns_owned:
+            # the anon replay namespace dies with this scorer — reclaim
+            # its bytes from the (possibly shared) store
+            self._cache.invalidate(self._cache_ns)
 
     def _note_progress(self) -> None:
         with self._state_lock:
@@ -222,12 +244,21 @@ class StreamScorer:
             attempt += 1
 
     # -- the commit path ---------------------------------------------------
-    def _commit_chunk(self, chunk: Chunk, out: Any, t_recv: float) -> None:
+    def _commit_chunk(self, chunk: Chunk, out: Any, t_recv: float,
+                      from_cache: bool = False) -> None:
         """Output-artifact write -> output record -> [crash window] ->
         commit.  Artifact names are the content-addressed chunk id, so
         a replayed chunk REWRITES the identical file instead of adding a
         second one — the no-duplicate half of exactly-once."""
         arr = np.asarray(out)
+        if self._cache is not None and not from_cache:
+            # record the scored output so a journal replay (a sink
+            # failure mid-run, a second run() in this process) can
+            # skip the re-dispatch — keyed on the content-addressed
+            # chunk id, inserted BEFORE the crash-window inject below
+            # so the replay that follows an injected commit fault
+            # finds it
+            self._cache.put(self._cache_ns + (chunk.chunk_id,), arr)
         name = f"out-{chunk.chunk_id}.npy"
         _write_artifact_atomic(os.path.join(self._out_dir, name), arr)
         self._journal.record_output(chunk.chunk_id, chunk.offset, name,
@@ -272,6 +303,7 @@ class StreamScorer:
             "chunks_scored": 0,
             "redeliveries": 0,
             "duplicates_suppressed": 0,
+            "cache_hits": 0,
         }
         self._source.seek(resume_offset)
         with self._state_lock:
@@ -317,11 +349,56 @@ class StreamScorer:
                 flight_emit("stream.redelivery", chunk_id=chunk.chunk_id,
                             offset=chunk.offset)
                 inject("stream.resume")
+                if self._cache is not None:
+                    cached = self._cache.get(
+                        self._cache_ns + (chunk.chunk_id,))
+                    if cached is not None:
+                        # replay short-circuit (ISSUE 11): a previous
+                        # run in this process already scored these
+                        # bytes — the chunk id IS the content digest,
+                        # so commit the cached output IMMEDIATELY
+                        # (deferring to the consumer would leave a
+                        # replayed-then-quiet stream with journaled
+                        # intents but no commits: watermark stuck, lag
+                        # growing, a restart re-replaying everything).
+                        # ``_commit_and_count`` serializes against the
+                        # consumer's commits, so running here — on the
+                        # pipeline's prepare thread when pipelined — is
+                        # race-free.  Exactly-once is untouched: the
+                        # intent -> output -> commit chain runs exactly
+                        # as it would post-dispatch.
+                        self._journal.begin(chunk.chunk_id, chunk.offset)
+                        self.metrics.incr("stream.chunks")
+                        self.metrics.incr("stream.cache_hits")
+                        begun += 1
+                        self._commit_and_count(chunk, cached,
+                                               time.monotonic(), summary,
+                                               cached=True)
+                        continue
             self._journal.begin(chunk.chunk_id, chunk.offset)
             self.metrics.incr("stream.chunks")
             pending.append((chunk, time.monotonic()))
             begun += 1
             yield chunk.payload
+
+    def _commit_and_count(self, chunk: Chunk, out: Any, t_recv: float,
+                          summary: Dict[str, Any],
+                          cached: bool = False) -> None:
+        """One commit + its summary accounting, serialized under the
+        commit lock: the consumer thread (live outputs) and the
+        delivery generator's replay short-circuit (the pipeline's
+        prepare thread) both route through here, so the exactly-once
+        bookkeeping can never race itself."""
+        with self._commit_lock:
+            with get_tracer().span("stream.chunk", offset=chunk.offset,
+                                   chunk_id=chunk.chunk_id,
+                                   cached=cached):
+                # from_cache: a cached value was just READ from its key
+                # — re-putting it would only pay a second copy + sha256
+                self._commit_chunk(chunk, out, t_recv, from_cache=cached)
+            if cached:
+                summary["cache_hits"] += 1
+            summary["chunks_scored"] += 1
 
     def _run_engine(self, summary: Dict[str, Any],
                     max_chunks: Optional[int]) -> None:
@@ -334,10 +411,7 @@ class StreamScorer:
                 self._deliveries(summary, pending, max_chunks),
                 window=self._window, pipeline=self._pipeline):
             chunk, t_recv = pending.popleft()
-            with get_tracer().span("stream.chunk", offset=chunk.offset,
-                                   chunk_id=chunk.chunk_id):
-                self._commit_chunk(chunk, out, t_recv)
-            summary["chunks_scored"] += 1
+            self._commit_and_count(chunk, out, t_recv, summary)
 
     def _run_serving(self, summary: Dict[str, Any],
                      max_chunks: Optional[int]) -> None:
@@ -349,10 +423,7 @@ class StreamScorer:
             chunk, t_recv = pending.popleft()
             futs = [self._sink.submit(row) for row in payload]
             out = np.stack([np.asarray(f.result()) for f in futs])
-            with get_tracer().span("stream.chunk", offset=chunk.offset,
-                                   chunk_id=chunk.chunk_id):
-                self._commit_chunk(chunk, out, t_recv)
-            summary["chunks_scored"] += 1
+            self._commit_and_count(chunk, out, t_recv, summary)
 
     # -- health ------------------------------------------------------------
     def health(self) -> Dict[str, Any]:

@@ -26,7 +26,6 @@ so sources stay trivially simple and deterministic.
 from __future__ import annotations
 
 import fnmatch
-import hashlib
 import os
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence
@@ -34,19 +33,23 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
+# The sha256-over-dtype/shape/bytes core moved to utils.digest (ISSUE
+# 11) so the serving result cache keys on the SAME digest; re-exported
+# here because every source implementation and test has imported the id
+# from this module since ISSUE 8 — the id string itself is unchanged,
+# so journals written before the move replay cleanly.
+from sparkdl_tpu.utils.digest import array_digest, content_chunk_id
 
-
-def content_chunk_id(offset: int, payload: Any) -> str:
-    """Stable content-addressed chunk id: zero-padded offset (so ids
-    sort in stream order) + sha256 over dtype/shape/bytes.  Two reads of
-    the same chunk — across processes, before and after a crash — always
-    agree; two different payloads at the same offset never do."""
-    arr = np.ascontiguousarray(payload)
-    h = hashlib.sha256()
-    h.update(str(arr.dtype).encode())
-    h.update(str(arr.shape).encode())
-    h.update(arr.tobytes())
-    return f"{offset:08d}-{h.hexdigest()[:16]}"
+__all__ = [
+    "content_chunk_id",
+    "array_digest",
+    "Chunk",
+    "StreamSource",
+    "MemorySource",
+    "DirectorySource",
+    "write_directory_chunk",
+    "finish_directory_stream",
+]
 
 
 @dataclass(frozen=True)

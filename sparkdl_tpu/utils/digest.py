@@ -1,0 +1,71 @@
+"""Content digests — THE one sha256-over-dtype/shape/bytes helper.
+
+Three layers had independently grown the same digest (ISSUE 11):
+``streaming.source.content_chunk_id`` (chunk identity — the
+exactly-once dedupe key), ``streaming.runner``'s artifact digest (the
+torn/foreign-file check ``assemble_outputs`` verifies), and now the
+serving result cache's input/output keys.  One implementation here
+means "same bytes" can never mean three subtly different things:
+every digest covers dtype, shape, AND bytes, so two arrays that merely
+reinterpret each other's buffers (f32 vs u8 views, [2, 6] vs [3, 4])
+never collide.
+
+Import-light on purpose (numpy + hashlib only; jax is imported lazily
+and only for pytree payloads): ``streaming.source`` and the journal
+pull this in on cold start, where a jax import would re-initialize the
+backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import numpy as np
+
+
+def array_digest(arr: Any) -> str:
+    """Full sha256 hexdigest over one array's dtype/shape/bytes.
+
+    The core ``content_chunk_id`` has used since ISSUE 8 (truncated to
+    16 hex chars there) and ``assemble_outputs`` verifies artifacts
+    against (full width).  Stable across processes and crashes: two
+    reads of the same payload always agree; two payloads differing in
+    dtype, shape, or any byte never do."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def content_chunk_id(offset: int, payload: Any) -> str:
+    """Stable content-addressed chunk id: zero-padded offset (so ids
+    sort in stream order) + sha256 over dtype/shape/bytes.  Two reads of
+    the same chunk — across processes, before and after a crash — always
+    agree; two different payloads at the same offset never do.
+
+    (Moved here from ``streaming.source`` by ISSUE 11 so the serving
+    cache shares the digest core; the id string is bit-for-bit what the
+    source has produced since ISSUE 8 — journals written before the
+    move replay cleanly.)"""
+    return f"{offset:08d}-{array_digest(payload)[:16]}"
+
+
+def content_digest(payload: Any) -> str:
+    """Digest of an arbitrary payload: a single array digests via
+    :func:`array_digest` (identical string — the serving cache and the
+    streaming layer agree on single-array payloads by construction); a
+    pytree of arrays digests each leaf plus the tree structure, so two
+    pytrees collide only when every leaf AND the structure match."""
+    if isinstance(payload, np.ndarray) or np.isscalar(payload):
+        return array_digest(payload)
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(payload)
+    h = hashlib.sha256()
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        h.update(array_digest(leaf).encode())
+    return h.hexdigest()

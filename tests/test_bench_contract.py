@@ -297,3 +297,60 @@ def test_relay_tag_formats_measured_profile(monkeypatch):
                         "d2h_MBps": 4.1})
     tag = bench._relay_tag()
     assert "108.5" in tag and "34.0" in tag and "4.1" in tag
+
+
+def test_pad_overhead_rider_on_every_line(captured):
+    """Every per-config line carries the ``pad_overhead`` rider (ISSUE
+    11, the prep step for ROADMAP item 2's ragged batching): the GC004
+    analytic bounds from the committed PROGRAMS.lock.json, plus the
+    measured pad-row fraction whenever the line's metrics snapshot
+    recorded the engine's rows/pad_rows ledger."""
+    bench.emit("2-Xception", "m", 3184.0, "images/sec/chip",
+               baseline_model="Xception")
+    rec = captured[-1]
+    lock = rec["pad_overhead"]["lockfile"]
+    assert "MobileNetV2" in lock and "InceptionV3" in lock
+    for model, b in lock.items():
+        assert b["buckets"] == sorted(b["buckets"])
+        # the analytic worst cases sit inside graftcheck's GC004
+        # budgets (interior 55% / floor 95%) — the committed bucket
+        # plan cannot quietly drift past what the auditor allows
+        assert 0.0 <= b["interior_worst_frac"] <= 0.55
+        assert 0.0 <= b["floor_frac"] <= 0.95
+    # a line whose snapshot carries the engine ledger gets the
+    # measured half stamped next to the analytic one
+    snap = {"counters": {"engine.rows": 30.0, "engine.pad_rows": 10.0},
+            "gauges": {}, "timings_s": {},
+            "histograms": {"serving.batch_fill_ratio":
+                           {"count": 4, "mean": 0.75,
+                            "p50": 0.75, "p99": 1.0}}}
+    bench.emit("serving", "m", 100.0, "images/sec",
+               extra={"metrics_snapshot": snap})
+    measured = captured[-1]["pad_overhead"]["measured"]
+    assert measured["pad_row_frac"] == pytest.approx(0.25)
+    assert measured["serving_pad_frac"] == pytest.approx(0.25)
+
+
+def test_cache_config_is_chipless_and_line_contract(captured, monkeypatch):
+    """The ``cache`` config is chipless by design (synthetic sleep
+    device) and its line is self-auditing: measured hit rate pinned
+    next to the analytic floor, dispatch counts for both passes, and
+    the bit-identical verdict (small replay via the env knobs to keep
+    this tier-1-cheap)."""
+    assert "cache" in bench._CHIPLESS_CONFIGS
+    monkeypatch.setenv("SPARKDL_BENCH_CACHE_REQUESTS", "24")
+    monkeypatch.setenv("SPARKDL_BENCH_CACHE_UNIVERSE", "6")
+    monkeypatch.setenv("SPARKDL_BENCH_CACHE_DISPATCH_MS", "5.0")
+    bench.bench_cache()
+    rec = captured[-1]
+    assert rec["config"] == "cache"
+    assert rec["unit"] == "x vs uncached serving path"
+    assert rec["value"] >= 1.5
+    assert rec["bit_identical"] is True
+    assert rec["hit_rate"] >= rec["analytic_hit_rate"]
+    assert rec["uncached_dispatches"] == rec["n_requests"] == 24
+    assert rec["cached_dispatches"] < rec["uncached_dispatches"]
+    assert rec["faults"] == "none"
+    for key in ("config", "metric", "value", "unit", "vs_baseline",
+                "baseline", "env_bound", "pad_overhead"):
+        assert key in rec

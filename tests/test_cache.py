@@ -1,0 +1,565 @@
+"""Content-addressed inference cache + single-flight coalescing
+(ISSUE 11).
+
+Tier-1, CPU-only, seconds-scale: the Zipfian replay benchmark (>= 1.5x
+over the uncached path, hit rate pinned to the analytic floor, outputs
+bit-identical to the uncached oracle), the coalescing contract (N
+concurrent identical requests -> exactly ONE engine dispatch), the
+hot-swap survival rule pinned against PROGRAMS.lock.json (which must
+NOT regenerate), the eviction/invalidation edges, the injected
+hit-corruption digest re-check, the streaming replay short-circuit, and
+the shared ``utils.digest`` contract from both its callers.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import faults
+from sparkdl_tpu.serving import InferenceCache, Server
+from sparkdl_tpu.serving.cache import (cache_from_env, example_digest,
+                                       lockfile_model_fingerprint,
+                                       zipfian_cache_benchmark)
+from sparkdl_tpu.utils.digest import (array_digest, content_chunk_id,
+                                      content_digest)
+
+
+def _fn(v, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ v["w"])
+
+
+def _variables(dim=8, out=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(dim, out)).astype(np.float32)}
+
+
+def _server(cache, variables=None, **kw):
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("max_wait_ms", 1.0)
+    return Server(_fn, variables if variables is not None else _variables(),
+                  cache=cache, **kw)
+
+
+def _wrap_slow(srv, sleep_s=0.0):
+    """Wrap every bucket engine's run_padded with a dispatch counter
+    (and optional synthetic slowness); returns the counter cell."""
+    calls = [0]
+    for b in srv.bucket_sizes:
+        eng = srv._engine_for(b)
+        real = eng.run_padded
+
+        def slow(batch, _real=real):
+            calls[0] += 1
+            if sleep_s:
+                time.sleep(sleep_s)
+            return _real(batch)
+
+        eng.run_padded = slow
+    return calls
+
+
+# -- utils.digest: the one sha256 core, contract-tested from both callers --
+def test_digest_shared_by_streaming_and_serving():
+    from sparkdl_tpu.streaming import runner, source
+
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    # the chunk id re-exported from streaming.source IS the utils.digest
+    # helper, and the id string is the pre-move format: padded offset +
+    # 16 hex chars of the full digest
+    assert source.content_chunk_id is content_chunk_id
+    cid = content_chunk_id(7, arr)
+    assert cid == f"{7:08d}-{array_digest(arr)[:16]}"
+    # the journal's artifact digest is the same core at full width
+    assert runner._array_digest is array_digest
+    # dtype/shape/bytes all discriminate
+    assert array_digest(arr) != array_digest(arr.astype(np.float64))
+    assert array_digest(arr) != array_digest(arr.reshape(6, 4))
+    mutated = arr.copy()
+    mutated[0, 0] += 1
+    assert array_digest(arr) != array_digest(mutated)
+    # serving's payload digest: a bare array digests identically to the
+    # streaming spelling; a pytree folds leaves + structure
+    assert content_digest(arr) == array_digest(arr)
+    assert content_digest({"a": arr}) != content_digest({"b": arr})
+    assert content_digest([arr, arr]) != content_digest([arr])
+    assert example_digest(arr) == content_digest(arr)
+
+
+# -- cache core ------------------------------------------------------------
+def test_hit_returns_independent_copy():
+    c = InferenceCache(max_entries=4, max_bytes=1 << 20)
+    key = ("ns", "d1")
+    val = np.arange(8, dtype=np.float32)
+    c.put(key, val)
+    got = c.get(key)
+    assert np.array_equal(got, val)
+    got[0] = 99.0  # a consumer scribbling on its row
+    again = c.get(key)
+    assert np.array_equal(again, val), "stored entry was aliased"
+
+
+def test_bytes_cap_evicts_in_lru_order():
+    row = np.zeros(256, dtype=np.float32)  # 1 KiB
+    c = InferenceCache(max_entries=100, max_bytes=int(2.5 * row.nbytes))
+    c.put(("a",), row)
+    c.put(("b",), row + 1)
+    c.put(("c",), row + 2)  # capacity 2 -> evicts a (oldest)
+    assert c.get(("a",)) is None
+    assert c.get(("b",)) is not None  # refreshes b to MRU
+    c.put(("d",), row + 3)  # evicts c, NOT the refreshed b
+    assert c.get(("c",)) is None
+    assert c.get(("b",)) is not None
+    counters = c.metrics.snapshot_raw()["counters"]
+    assert counters["cache.evictions"] == 2.0
+    assert c.total_bytes <= int(2.5 * row.nbytes)
+    # an entry bigger than the whole budget is served but never stored
+    big = np.zeros(4096, dtype=np.float32)
+    c.put(("big",), big)
+    assert c.get(("big",)) is None
+
+
+def test_zero_capacity_disables_cleanly():
+    for kw in ({"max_entries": 0}, {"max_bytes": 0}):
+        c = InferenceCache(**kw)
+        c.put(("k",), np.ones(4))
+        assert len(c) == 0 and c.total_bytes == 0
+        assert c.get(("k",)) is None
+        # the serving path still works end to end over a disabled store
+        with _server(c) as srv:
+            x = np.ones(8, np.float32)
+            y1 = srv.predict(x)
+            y2 = srv.predict(x)
+        assert np.array_equal(y1, y2)
+        assert len(c) == 0
+
+
+def test_namespace_isolation_between_servers():
+    cache = InferenceCache()
+    x = np.ones(8, np.float32)
+    with _server(cache, _variables(seed=1)) as s1, \
+            _server(cache, _variables(seed=2)) as s2:
+        y1 = s1.predict(x)
+        y2 = s2.predict(x)
+        # same input bytes, different models: the auto-assigned
+        # namespaces keep the entries apart
+        assert not np.array_equal(y1, y2)
+        assert s1.cache_namespace != s2.cache_namespace
+        assert np.array_equal(s1.predict(x), y1)
+        assert np.array_equal(s2.predict(x), y2)
+    counters = cache.metrics.snapshot_raw()["counters"]
+    assert counters["cache.hits"] == 2.0
+    assert counters["cache.misses"] == 2.0
+
+
+def test_close_reclaims_owned_anon_namespace():
+    cache = InferenceCache()
+    x = np.ones(8, np.float32)
+    srv = _server(cache)
+    srv.predict(x)
+    assert len(cache) == 1
+    srv.close()
+    assert len(cache) == 0 and cache.total_bytes == 0, (
+        "a closed server's anon namespace must not orphan bytes in the "
+        "shared store")
+    # explicit namespaces are NOT owned — their lifecycle belongs to
+    # whoever assigned them (the fleet's swap/rollback paths)
+    srv2 = _server(cache, cache_namespace=("shared", "ns"))
+    srv2.predict(x)
+    srv2.close()
+    assert len(cache) == 1
+
+
+def test_adopt_collision_keeps_byte_ledger_consistent():
+    c = InferenceCache()
+    row = np.zeros(64, np.float32)
+    c.put(("old", "k1"), row)
+    c.put(("old", "k2"), row)
+    c.put(("new", "k1"), row + 1)  # a post-flip racer already settled k1
+    before = c.total_bytes
+    moved = c.adopt(("old",), ("new",))
+    assert moved == 1  # k2 moved; the k1 collision kept the fresher entry
+    assert len(c) == 2
+    assert c.total_bytes == before - row.nbytes, (
+        "adopt over an existing key must release the replaced bytes")
+    assert np.array_equal(c.get(("new", "k1")), row + 1)
+    assert np.array_equal(c.get(("new", "k2")), row)
+
+
+# -- single flight ---------------------------------------------------------
+def test_coalescing_n_concurrent_identical_one_dispatch():
+    cache = InferenceCache()
+    with _server(cache, max_wait_ms=5.0, max_queue=64) as srv:
+        x = np.ones(8, np.float32)
+        srv.warmup(x)
+        calls = _wrap_slow(srv, sleep_s=0.4)
+        futs = [srv.submit(x) for _ in range(6)]
+        outs = [f.result(timeout=30) for f in futs]
+    assert calls[0] == 1, (
+        f"6 concurrent identical requests cost {calls[0]} dispatches; "
+        f"single-flight coalescing must make that exactly 1")
+    oracle = outs[0]
+    assert all(np.array_equal(o, oracle) for o in outs)
+    counters = cache.metrics.snapshot_raw()["counters"]
+    assert counters["cache.misses"] == 1.0
+    assert counters["cache.coalesced"] == 5.0
+    # follower rows are copies, not views of one buffer
+    outs[1][0] = 123.0
+    assert not np.array_equal(outs[1], outs[2])
+
+
+def test_leader_failure_settles_followers_and_caches_nothing():
+    cache = InferenceCache()
+    plan = faults.FaultPlan.parse(
+        "cache.stampede:sleep:ms=300,times=1;"
+        "serving.model:error:exc=fatal,times=1")
+    with _server(cache, max_wait_ms=5.0) as srv:
+        x = np.ones(8, np.float32)
+        srv.warmup(x)
+        with faults.active(plan):
+            leader_fut = [None]
+
+            def lead():
+                # blocks ~300ms inside submit at cache.stampede, giving
+                # the followers below a deterministic window to park
+                leader_fut[0] = srv.submit(x)
+
+            t = threading.Thread(target=lead)
+            t.start()
+            time.sleep(0.1)  # leader is inside its stampede window
+            followers = [srv.submit(x) for _ in range(3)]
+            t.join()
+            with pytest.raises(faults.InjectedFatalError):
+                leader_fut[0].result(timeout=30)
+            for f in followers:
+                with pytest.raises(faults.InjectedFatalError):
+                    f.result(timeout=30)
+        assert len(cache) == 0, "a failed dispatch must cache nothing"
+        # the error was not sticky: the next request recomputes fine
+        y = srv.predict(x)
+    assert y.shape == (4,)
+    counters = cache.metrics.snapshot_raw()["counters"]
+    assert counters["cache.leader_failures"] == 1.0
+    assert counters["cache.coalesced"] == 3.0
+
+
+def test_leader_settles_before_caller_and_result_is_unaliased():
+    cache = InferenceCache()
+    with _server(cache) as srv:
+        x = np.ones(8, np.float32)
+        fut = srv.submit(x)
+        y = fut.result(timeout=30)
+        # the caller-facing future resolves only AFTER settle stored
+        # its copy — so the caller can never race the insert...
+        assert len(cache) == 1
+        y[:] = -1.0  # ...and scribbling on the returned row is safe
+        y2 = srv.predict(x)
+    assert not np.array_equal(y, y2), "stored entry aliased the row " \
+                                      "handed to the leader's caller"
+    assert cache.metrics.snapshot_raw()["counters"]["cache.hits"] == 1.0
+
+
+def test_follower_keeps_its_own_deadline():
+    from sparkdl_tpu.serving import DeadlineExceededError
+
+    cache = InferenceCache()
+    with _server(cache, max_wait_ms=5.0) as srv:
+        x = np.ones(8, np.float32)
+        srv.warmup(x)
+        _wrap_slow(srv, sleep_s=0.6)
+        leader = srv.submit(x)  # no deadline of its own
+        follower = srv.submit(x, timeout_ms=100)
+        with pytest.raises(DeadlineExceededError):
+            follower.result(timeout=30)
+        # the leader (and the cache insert) are unaffected
+        assert leader.result(timeout=30).shape == (4,)
+
+
+def test_injected_hit_corruption_caught_by_digest_recheck():
+    cache = InferenceCache()
+    with _server(cache) as srv:
+        x = np.ones(8, np.float32)
+        y1 = srv.predict(x)  # populates
+        calls = _wrap_slow(srv)
+        with faults.active(faults.FaultPlan.parse(
+                "cache.hit:error:times=1")):
+            y2 = srv.predict(x)  # hit path corrupts -> re-dispatch
+        # read BEFORE close(): the server reclaims its anon namespace
+        # on close, which adds a second (unrelated) invalidation
+        counters = cache.metrics.snapshot_raw()["counters"]
+    assert np.array_equal(y1, y2), "corrupt entry leaked to a caller"
+    assert calls[0] == 1, "corruption must demote the hit to a dispatch"
+    assert counters["cache.corruptions"] == 1.0
+    assert counters["cache.invalidations"] == 1.0
+
+
+# -- the headline benchmark ------------------------------------------------
+def test_zipfian_replay_speedup_hit_rate_and_oracle():
+    res = zipfian_cache_benchmark(n_requests=48, universe=8,
+                                  dispatch_ms=6.0, seed=0)
+    assert res["bit_identical"], (
+        "cached outputs diverged from the uncached oracle")
+    assert res["hit_rate"] >= res["analytic_hit_rate"], res
+    assert res["speedup"] >= 1.5, (
+        f"cache speedup {res['speedup']}x under Zipfian replay below "
+        f"the 1.5x contract")
+    assert res["uncached_dispatches"] == res["n_requests"]
+    assert res["cached_dispatches"] == res["distinct"]
+    assert res["cache_entries"] == res["distinct"]
+
+
+# -- env gate / config -----------------------------------------------------
+def test_sparkdl_cache_grammar(monkeypatch):
+    monkeypatch.delenv("SPARKDL_CACHE", raising=False)
+    assert cache_from_env() is None
+    for off in ("0", "off", "no", "false", ""):
+        monkeypatch.setenv("SPARKDL_CACHE", off)
+        assert cache_from_env() is None
+    monkeypatch.setenv("SPARKDL_CACHE", "1")
+    c = cache_from_env()
+    assert isinstance(c, InferenceCache)
+    monkeypatch.setenv("SPARKDL_CACHE", "entries=8,mb=2")
+    c = cache_from_env()
+    assert c.max_entries == 8 and c.max_bytes == 2 << 20
+    monkeypatch.setenv("SPARKDL_CACHE", "bogus")
+    with pytest.raises(ValueError):
+        cache_from_env()
+    monkeypatch.setenv("SPARKDL_CACHE", "entries=zap")
+    with pytest.raises(ValueError):
+        cache_from_env()
+
+
+def test_server_uncached_by_default(monkeypatch):
+    from sparkdl_tpu.serving import cache as cache_mod
+
+    monkeypatch.delenv("SPARKDL_CACHE", raising=False)
+    cache_mod.configure_from_env()
+    try:
+        with _server(cache=None) as srv:
+            assert srv.cache is None
+            x = np.ones(8, np.float32)
+            np.testing.assert_array_equal(srv.predict(x), srv.predict(x))
+            assert srv.varz()["cache"] is None
+    finally:
+        cache_mod.configure_from_env()
+
+
+def test_varz_carries_cache_section_json_serializable():
+    cache = InferenceCache()
+    with _server(cache) as srv:
+        x = np.ones(8, np.float32)
+        srv.predict(x)
+        srv.predict(x)
+        v = srv.varz()
+    json.dumps(v)  # the monitoring endpoint body must stay serializable
+    assert v["cache"]["entries"] == 1
+    assert v["cache"]["counters"]["cache.hits"] == 1.0
+    assert v["counters"]["serving.cache_hits"] == 1.0
+
+
+# -- hot-swap survival pinned against PROGRAMS.lock.json -------------------
+def _swap_fleet(cache, fingerprints, w1, w2):
+    from sparkdl_tpu.serving import Fleet
+
+    fleet = Fleet(max_batch_size=8, max_wait_ms=1.0, cache=cache,
+                  program_fingerprints=fingerprints)
+    fleet.add_model("m", _fn, w1)
+    fleet.add_version("m", w2)
+    return fleet
+
+
+def test_unchanged_fingerprint_promote_keeps_entries():
+    import os
+
+    lock_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PROGRAMS.lock.json")
+    with open(lock_path, "rb") as fh:
+        lock_before = fh.read()
+    cache = InferenceCache()
+    w = _variables()
+    fleet = _swap_fleet(cache, {"m": "fp-stable"}, w, w)
+    x = np.ones(8, np.float32)
+    y1 = fleet.predict("m", x)
+    fleet.start_rollout("m", canary_fraction=0.0)
+    report = fleet.promote("m")
+    assert report["cache"] == {"survived": True, "entries": 1,
+                              "fingerprint_unchanged": True,
+                              "weights_unchanged": True}
+    calls = _wrap_slow(fleet._state("m").server)
+    y2 = fleet.predict("m", x)  # the v1-warmed entry serves v2
+    fleet.close()
+    assert calls[0] == 0, "unchanged-fingerprint promote must stay warm"
+    assert np.array_equal(y1, y2)
+    assert cache.metrics.snapshot_raw()["counters"]["cache.hits"] == 1.0
+    with open(lock_path, "rb") as fh:
+        assert fh.read() == lock_before, "PROGRAMS.lock.json regenerated"
+
+
+def test_changed_fingerprint_promote_invalidates():
+    cache = InferenceCache()
+    fps = {"m": "fp-v1"}
+    w = _variables()
+    fleet = _swap_fleet(cache, lambda name, entry: fps[name], w, w)
+    x = np.ones(8, np.float32)
+    y1 = fleet.predict("m", x)
+    fps["m"] = "fp-v2"  # the committed program moved between deploys
+    fleet.start_rollout("m", canary_fraction=0.0)
+    report = fleet.promote("m")
+    assert report["cache"]["survived"] is False
+    assert report["cache"]["fingerprint_unchanged"] is False
+    assert len(cache) == 0, "changed fingerprint must drop the entries"
+    calls = _wrap_slow(fleet._state("m").server)
+    y2 = fleet.predict("m", x)  # miss -> fresh dispatch
+    fleet.close()
+    assert calls[0] == 1
+    assert np.array_equal(y1, y2)  # same weights, so same answer
+    counters = cache.metrics.snapshot_raw()["counters"]
+    assert counters["cache.invalidations"] >= 1.0
+
+
+def test_new_weights_promote_invalidates_despite_fingerprint():
+    cache = InferenceCache()
+    w1, w2 = _variables(seed=1), _variables(seed=2)
+    fleet = _swap_fleet(cache, {"m": "fp-stable"}, w1, w2)
+    x = np.ones(8, np.float32)
+    y1 = fleet.predict("m", x)
+    fleet.start_rollout("m", canary_fraction=0.0)
+    report = fleet.promote("m")
+    assert report["cache"]["survived"] is False
+    assert report["cache"]["fingerprint_unchanged"] is True
+    assert report["cache"]["weights_unchanged"] is False
+    y2 = fleet.predict("m", x)
+    fleet.close()
+    # v2 genuinely computes different outputs — serving the v1 entry
+    # would have been a correctness bug, not a cache win
+    assert not np.array_equal(y1, y2)
+
+
+def test_rollback_drops_canary_namespace_keeps_stable():
+    cache = InferenceCache()
+    w = _variables()
+    fleet = _swap_fleet(cache, {"m": "fp-stable"}, w, w)
+    x = np.ones(8, np.float32)
+    y1 = fleet.predict("m", x)  # warms v1
+    ro = fleet.start_rollout("m", canary_fraction=1.0)
+    y_canary = fleet.predict("m", x)  # warms the canary namespace
+    assert len(cache) == 2
+    report = fleet.rollback("m")
+    assert report["cache"]["survived"] is False
+    assert len(cache) == 1, "rollback must reclaim the canary entries"
+    calls = _wrap_slow(fleet._state("m").server)
+    y2 = fleet.predict("m", x)
+    fleet.close()
+    assert calls[0] == 0, "the stable entries must survive a rollback"
+    assert np.array_equal(y1, y2) and np.array_equal(y1, y_canary)
+    assert ro.active is False
+
+
+def test_lockfile_model_fingerprint_resolves_from_committed_lock():
+    fp1 = lockfile_model_fingerprint("MobileNetV2")
+    fp2 = lockfile_model_fingerprint("MobileNetV2")
+    assert fp1 is not None and fp1 == fp2, "must be deterministic"
+    assert lockfile_model_fingerprint("InceptionV3") != fp1
+    assert lockfile_model_fingerprint("NoSuchModel") is None
+    assert lockfile_model_fingerprint(
+        "MobileNetV2", path="/nonexistent/lock.json") is None
+
+
+# -- streaming replay ------------------------------------------------------
+def test_stream_replay_hits_cache_instead_of_redispatching(tmp_path):
+    import os
+
+    from sparkdl_tpu import streaming
+    from sparkdl_tpu.parallel.engine import InferenceEngine
+
+    rng = np.random.default_rng(3)
+    v = {"w": rng.normal(size=(16, 8)).astype(np.float32)}
+    eng = InferenceEngine(_fn, v, device_batch_size=32)
+    payloads = [rng.normal(size=(32, 16)).astype(np.float32)
+                for _ in range(6)]
+    jp = str(tmp_path / "j.jsonl")
+    od = str(tmp_path / "out")
+    cache = InferenceCache()
+    ns = ("stream", "t")
+    sc1 = streaming.StreamScorer(
+        eng, streaming.MemorySource(payloads, finished=True),
+        journal_path=jp, out_dir=od, pipeline=False,
+        cache=cache, cache_namespace=ns)
+    with faults.active(faults.FaultPlan.parse(
+            "stream.commit:error:exc=fatal,at=3")):
+        with pytest.raises(faults.InjectedFatalError):
+            sc1.run()  # dies between output write and commit
+    sc1.close()
+    calls = [0]
+    real = eng.run_padded
+
+    def counting(batch):
+        calls[0] += 1
+        return real(batch)
+
+    eng.run_padded = counting
+    sc2 = streaming.StreamScorer(
+        eng, streaming.MemorySource(payloads, finished=True),
+        journal_path=jp, out_dir=od, pipeline=False,
+        cache=cache, cache_namespace=ns)
+    s2 = sc2.run()
+    sc2.close()
+    eng.run_padded = real
+    assert s2["cache_hits"] == 1, s2
+    assert s2["redeliveries"] >= 1
+    # the crashed chunk (offset 2) came from the cache: only the
+    # genuinely unscored chunks 3..5 paid a dispatch on resume
+    assert calls[0] == 3, calls
+    got = streaming.assemble_outputs(jp, od)
+    oracle = np.concatenate(
+        [np.asarray(o) for o in eng.map_batches(payloads, pipeline=False)],
+        axis=0)
+    assert np.array_equal(got, oracle), "resume must stay bit-identical"
+    assert os.path.isdir(od)
+
+
+# -- observability ---------------------------------------------------------
+def test_cache_events_cataloged_and_on_blackbox_timeline(tmp_path):
+    from sparkdl_tpu.obs import flight
+    from tools.blackbox import build_timeline
+
+    for name in ("cache.hit", "cache.miss", "cache.coalesced",
+                 "cache.evict", "cache.invalidate"):
+        assert name in flight.EVENT_HELP
+        flight.validate_event(name)
+    rec = flight.configure(enabled=True, out_dir=str(tmp_path))
+    try:
+        cache = InferenceCache(max_entries=1, max_bytes=1 << 20)
+        cache.put(("a",), np.ones(4))
+        cache.get(("a",))        # cache.hit
+        cache.put(("b",), np.ones(4))  # cache.evict (entries cap = 1)
+        cache.invalidate(("b",))       # cache.invalidate
+        with _server(cache) as srv:
+            x = np.ones(8, np.float32)
+            srv.predict(x)       # cache.miss
+            srv.predict(x)       # cache.hit
+        path = rec.dump()
+    finally:
+        flight.configure_from_env()
+    doc = build_timeline(path)
+    chain = doc["chain"]
+    for name in ("cache.hit", "cache.miss", "cache.evict",
+                 "cache.invalidate"):
+        assert name in chain, f"{name} missing from blackbox timeline"
+    assert doc["counts"]["cache.hit"] >= 2
+
+
+def test_faults_sites_registered_for_cache():
+    from sparkdl_tpu.faults.sites import SITE_HELP, validate_site
+
+    for site in ("cache.hit", "cache.stampede"):
+        assert site in SITE_HELP
+        validate_site(site)
+    # spec grammar accepts them end to end
+    plan = faults.FaultPlan.parse(
+        "seed=5;cache.hit:error:times=1;cache.stampede:sleep:ms=1")
+    assert plan.has_rules("cache.hit") and plan.has_rules("cache.stampede")

@@ -62,7 +62,22 @@ def _randomize_bn(model, rng):
         layer.set_weights(new)
 
 
-@pytest.mark.parametrize("name", SUPPORTED_MODELS)
+# Tier-1 time budget (ISSUE 11 satellite): the ResNet family's shape
+# and keras-parity contracts are identical block structure at three
+# depths, and the two DEEP twins are by far the heaviest calls in the
+# whole tier-1 suite (~77s of feature-cut shapes + ~34s of logit
+# parity on the CPU backend).  They carry the `slow` mark: ResNet50
+# keeps the family inside the tier-1 gate, and run-tests.sh's full
+# pass (no `-m` filter) still runs the deep twins on every gate.
+_DEEP_RESNETS = ("ResNet101", "ResNet152")
+
+
+def _budgeted(models):
+    return [pytest.param(n, marks=pytest.mark.slow)
+            if n in _DEEP_RESNETS else n for n in models]
+
+
+@pytest.mark.parametrize("name", _budgeted(SUPPORTED_MODELS))
 def test_logit_parity_vs_keras(name):
     spec = get_model_spec(name)
     keras_model = _build_keras(spec)
@@ -85,7 +100,7 @@ def test_logit_parity_vs_keras(name):
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("name", SUPPORTED_MODELS)
+@pytest.mark.parametrize("name", _budgeted(SUPPORTED_MODELS))
 def test_feature_cut_shape(name):
     spec = get_model_spec(name)
     module = spec.build()
