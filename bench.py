@@ -1254,6 +1254,58 @@ def bench_streaming():
          })
 
 
+_RAGGED_BENCH = r"""
+import json, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.parallel import compile_cache
+from sparkdl_tpu.serving.batcher import ragged_arrival_benchmark
+out = ragged_arrival_benchmark(
+    n_bursts=int(os.environ.get("SPARKDL_BENCH_RAGGED_BURSTS", "10")),
+    dispatch_ms=float(os.environ.get("SPARKDL_BENCH_RAGGED_DISPATCH_MS",
+                                     "8.0")))
+out["compile_cache"] = compile_cache.state()  # non-null when the env
+# carries SPARKDL_COMPILE_CACHE — a warm dir makes this line's compile
+# half a restart-cost measurement too
+print(json.dumps(out))
+"""
+
+
+def bench_ragged():
+    """Continuous ragged batching under a seeded mixed-size arrival
+    replay on the synthetic slow device (ISSUE 13): measured pad-row
+    reduction vs the flush-on-full baseline (the engine's
+    rows/pad_rows ledger), mean fill-ratio movement, and a
+    bit-identical-outputs verdict — the serving-side half of the
+    raw-speed pass, chip-free by construction."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_RAGGED_BENCH, timeout_s=480, env=env)
+    saved = prof["pad_rows_saved"]
+    emit("ragged",
+         "ragged-batching pad-row reduction under mixed-size arrival "
+         "replay (synthetic slow device)",
+         saved, "pad rows saved vs flush-on-full baseline",
+         env_bound="synthetic: deterministic sleep device on host CPU "
+                   "(measures the batcher/bucket layer, not the chip)",
+         extra={
+             "n_requests": prof["n_requests"],
+             "n_bursts": prof["n_bursts"],
+             "bucket_sizes": prof["bucket_sizes"],
+             "dispatch_ms": prof["dispatch_ms"],
+             "flush_pad_frac": prof["flush_pad_frac"],
+             "ragged_pad_frac": prof["ragged_pad_frac"],
+             "flush_fill_mean": prof["flush"]["fill_mean"],
+             "ragged_fill_mean": prof["ragged"]["fill_mean"],
+             "ragged_topoff_rows": prof["ragged"]["topoff_rows"],
+             "bit_identical": prof["bit_identical"],
+             "compile_cache": prof.get("compile_cache"),
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -1266,16 +1318,18 @@ BENCHES = {
     "pipeline": bench_pipeline,
     "streaming": bench_streaming,
     "cache": bench_cache,
+    "ragged": bench_ragged,
 }
 
 
 # Configs that never need the chip: "serving" and "fleet" run on their
 # CPU fallback (they measure the serving/fleet envelopes —
-# queue/batching/admission/swap/dispatch), "pipeline" and "cache"
-# simulate their device with a deterministic sleep, and "streaming"
-# measures the journal'd crash-resume path on synthetic in-memory
-# chunks.
-_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming", "cache")
+# queue/batching/admission/swap/dispatch), "pipeline", "cache", and
+# "ragged" simulate their device with a deterministic sleep, and
+# "streaming" measures the journal'd crash-resume path on synthetic
+# in-memory chunks.
+_CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming", "cache",
+                     "ragged")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -1323,7 +1377,8 @@ def main():
     except Exception as e:  # profile failure must not block the bench
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
-    default = "1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming,cache"
+    default = ("1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming,cache,"
+               "ragged")
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

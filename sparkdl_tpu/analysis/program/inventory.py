@@ -185,6 +185,51 @@ def fleet_dispatch_specs(models: Optional[Sequence[str]] = None,
                               mesh=mesh)
 
 
+def generic_dispatch_specs(feature_dim: int = 16,
+                           mesh=None) -> List[ProgramSpec]:
+    """The donated GENERIC serving program (ISSUE 13 satellite):
+    ``Server`` auto-donates the per-dispatch batch buffer for non-zoo
+    float-input models whenever its eval-shape probe proves XLA will
+    consume the donation (``Server._probe_donate``), and this spec
+    audits that claim — a square float linear head built through the
+    SAME ``build_dispatch_jit(donate_batch=True)`` constructor the
+    serving path uses, declaring ``donate=(1,)`` with NO recorded
+    exemption, so GC001 fails loudly if the donation ever stops
+    aliasing.  The zoo programs stay donate-off (their uint8 batch can
+    never alias — ``ZOO_DONATE_REASON``); this is the program shape
+    where donation is actually consumable, pinned in the lockfile."""
+    from sparkdl_tpu.parallel.engine import (effective_device_batch,
+                                             resolve_engine_mesh)
+
+    mesh = resolve_engine_mesh(mesh)
+    axes = _mesh_axes(mesh)
+    b = effective_device_batch(32, mesh)
+
+    def _build():
+        import jax
+        import numpy as np
+
+        from sparkdl_tpu.parallel.engine import build_dispatch_jit
+
+        def fn(v, x):
+            import jax.numpy as jnp
+
+            return jnp.tanh(x @ v["w"])
+
+        jitted = build_dispatch_jit(fn, mesh, donate_batch=True)
+        variables = {"w": jax.ShapeDtypeStruct(
+            (feature_dim, feature_dim), np.float32)}
+        batch = jax.ShapeDtypeStruct((b, feature_dim), np.float32)
+        return jitted, (variables, batch)
+
+    return [ProgramSpec(
+        name=f"serving/generic/tanh_linear/f32/b{b}",
+        kind="dispatch", build=_build, donate=(1,),
+        batch_rows=b, mesh_axes=axes,
+        shardings=("replicated", "batch"),
+        group="serving/generic/tanh_linear/f32")]
+
+
 def train_step_specs(batch_rows: int = 32, feature_dim: int = 2048,
                      num_classes: int = 10, mesh=None) -> List[ProgramSpec]:
     """The data-parallel train-step programs the estimator layer
@@ -351,6 +396,10 @@ def stack_programs(max_batch_size: int = 32,
     specs = zoo_dispatch_specs(max_batch_size=max_batch_size,
                                models=models, compute_dtype=compute_dtype,
                                mesh=mesh)
+    # the donated generic serving program rides every audit (subset
+    # ones included): it is model-independent and cheap to lower, and
+    # GC001's consumed-donation check is the whole point of it
+    specs.extend(generic_dispatch_specs(mesh=mesh))
     if include_train:
         # the train batch is the estimator's default fit batch, NOT a
         # serving bucket — keep it fixed so subset audits (--models /

@@ -28,6 +28,15 @@ SITE_HELP = {
     "pipeline.gather": "PipelinedRunner gather stage loop",
     "serving.admit": "DynamicBatcher.submit admission",
     "serving.model": "Server model-call attempt (watchdog-timed)",
+    "batch.topoff": ("ragged top-off pull in Server._execute — a sleep "
+                     "rule holds a forming batch open before dispatch; "
+                     "an error rule aborts the pull, which must degrade "
+                     "to baseline padding (base batch still dispatches, "
+                     "no request lost)"),
+    "compile.cache": ("persistent compile-cache configure/validation "
+                      "(parallel.compile_cache) — an injected error is "
+                      "a corrupt cache dir/manifest, which must degrade "
+                      "to fresh compiles, never take down serving"),
     "cache.hit": ("InferenceCache hit return path — an injected error "
                   "corrupts the copy handed back, which the output-"
                   "digest re-check must catch (entry invalidated, "

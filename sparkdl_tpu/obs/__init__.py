@@ -25,9 +25,14 @@ spans, and every run can export a machine-readable record.
   ``Server.varz()``/``Fleet.varz()``/``StreamScorer.health()``.
 
 Instrumented surfaces: ``serving.Server``/``DynamicBatcher`` (request +
-micro-batch spans; shed/drain flight events), ``parallel.engine.
+micro-batch spans; shed/drain flight events; ``batch.topoff`` events +
+``serving.topoff_rows``/``serving.batch_fill_ratio`` metrics for the
+ragged top-off path), ``parallel.engine.
 InferenceEngine`` (call/dispatch spans; breaker open/half-open/close
-flight events), ``parallel.pipeline.PipelinedRunner`` (per-stage spans
+flight events; the ``engine.rows``/``engine.pad_rows`` pad ledger),
+``parallel.compile_cache`` (``compile.persist``/``compile.invalidate``
+flight events + hit/miss counters for the persistent executable
+store), ``parallel.pipeline.PipelinedRunner`` (per-stage spans
 with ``block_until_ready``-bracketed device time),
 ``serving.fleet.Fleet`` (rollout start/promote/rollback + tenant-shed
 flight events), ``serving.cache.InferenceCache`` (hit/miss/coalesced/

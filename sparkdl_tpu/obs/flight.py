@@ -91,6 +91,17 @@ EVENT_HELP = {
     "serving.shed": ("Server shed a request (queue full, breaker open, "
                      "or deadline expired — see attrs.reason)"),
     "serving.drain": "Server.close() began stopping/draining",
+    "batch.topoff": ("a forming ragged micro-batch absorbed late "
+                     "arrivals up to its bucket boundary before "
+                     "dispatch (attrs: rows pulled, base fill, bucket)"),
+    "compile.persist": ("persistent XLA compile cache enabled and "
+                        "validated against the committed program "
+                        "lockfile (attrs name the dir and whether an "
+                        "existing population was reused)"),
+    "compile.invalidate": ("program-lockfile drift invalidated the "
+                           "persistent compile cache — stale entries "
+                           "purged, drift classified back to the GC "
+                           "rule whose invariant moved"),
     "cache.hit": ("inference cache served a result without an engine "
                   "dispatch (digest re-check passed)"),
     "cache.miss": ("inference cache miss — this request became the "

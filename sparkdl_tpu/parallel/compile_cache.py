@@ -1,0 +1,322 @@
+"""Persistent XLA compilation cache keyed on the program lockfile.
+
+Every fleet deploy and serving cold-start re-jits each bucket's
+dispatch program from scratch — seconds per bucket for real models,
+paid again on every process restart even though ``PROGRAMS.lock.json``
+proves the programs have not changed since the last audit.  This
+module wires JAX's persistent compilation cache (an on-disk executable
+store, content-addressed by the compiled program) under a
+``SPARKDL_COMPILE_CACHE`` gate and adds the lockfile keying the raw
+jax knob lacks: the cache directory carries a manifest recording the
+committed lockfile's program records (StableHLO fingerprints, dtype
+mixes, donation maps, ...), and a manifest that no longer matches the
+live lockfile invalidates the population CLEANLY — stale entries are
+purged before a single executable is served, and the drift is
+classified back to the graftcheck rule whose invariant moved
+(:func:`~sparkdl_tpu.analysis.program.lockfile.diff_records` — a
+dropped donation is GC001, an f32 upcast is GC002, and so on), so an
+operator reading the ``compile.invalidate`` flight event knows WHY the
+cold-start got slow again.
+
+Gate: ``SPARKDL_COMPILE_CACHE`` (the ``SPARKDL_BLACKBOX`` grammar)
+  * ``""``/``0``/``false``/``off``/``no`` — DISABLED (the default:
+    nothing about compilation changes, and the per-engine probe is one
+    module-global read).
+  * ``1``/``true``/``on``/``yes`` — enabled at the default directory
+    (``~/.cache/sparkdl_tpu/compile``).
+  * anything else — treated as the cache DIRECTORY.
+
+Resolution is the faults-pattern process singleton: the first
+:class:`~sparkdl_tpu.parallel.engine.InferenceEngine` construction
+consults the env exactly once (:func:`ensure_from_env`, serialized
+under the configure lock) and every later engine sees the resolved
+state.  Configuration failures — unwritable directory, corrupt
+manifest, the injected ``compile.cache`` fault — degrade to DISABLED
+(fresh compiles, a warning, never a serving outage): the cache is an
+optimization, not a dependency.
+
+Hit/miss accounting rides ``jax.monitoring``'s compilation-cache
+events into :func:`stats`, which is what the cross-process proof in
+run-tests.sh / tests asserts: process A compiles and populates, and a
+restarted process B serving the same lockfile-pinned programs reports
+ZERO fresh compiles (``misses == 0``) with bit-identical outputs; a
+tampered manifest fingerprint forces a purge + clean recompile instead
+of ever serving a stale executable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+from sparkdl_tpu.faults import inject
+from sparkdl_tpu.obs.flight import emit as flight_emit
+from sparkdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "MANIFEST_NAME",
+    "DEFAULT_DIR",
+    "dir_from_env",
+    "configure",
+    "configure_from_env",
+    "ensure_from_env",
+    "state",
+    "stats",
+    "enabled",
+]
+
+#: the lockfile-keyed manifest written next to jax's cache entries;
+#: upper-cased so it can never collide with a jax ``jit_*`` entry name
+MANIFEST_NAME = "SPARKDL_COMPILE_CACHE_MANIFEST.json"
+MANIFEST_SCHEMA = 1
+
+DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                           "sparkdl_tpu", "compile")
+
+_OFF = ("", "0", "false", "off", "no")
+_ON = ("1", "true", "on", "yes")
+
+# -- process singleton (the faults.inject / serving.cache pattern) ---------
+_UNSET = object()
+_state: Any = _UNSET    # None = disabled; dict = the resolved snapshot
+_lock = named_lock("parallel.compile_cache")
+_counts = {"hits": 0, "misses": 0}
+_listener = [False]
+
+
+def dir_from_env() -> Optional[str]:
+    """The cache directory per the ``SPARKDL_COMPILE_CACHE`` grammar
+    (module docstring), or None when the knob is off/unset."""
+    raw = os.environ.get("SPARKDL_COMPILE_CACHE", "").strip()
+    low = raw.lower()
+    if low in _OFF:
+        return None
+    if low in _ON:
+        return DEFAULT_DIR
+    return os.path.expanduser(raw)
+
+
+def _install_listener() -> None:
+    """Count jax's compilation-cache monitoring events into
+    :func:`stats` (registered once; the events only fire while the
+    persistent cache is active, so an idle listener costs nothing)."""
+    if _listener[0]:
+        return
+    import jax.monitoring as monitoring
+
+    def _count(name: str, **kwargs: Any) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            _counts["hits"] += 1
+        elif name == "/jax/compilation_cache/cache_misses":
+            _counts["misses"] += 1
+
+    monitoring.register_event_listener(_count)
+    _listener[0] = True
+
+
+def _norm(value: Any) -> Any:
+    return json.loads(json.dumps(value, sort_keys=True))
+
+
+def _purge(dir_path: str) -> int:
+    """Drop every cache entry (the manifest is rewritten by the caller)
+    so nothing stale can ever be served after an invalidation; returns
+    the number of entries removed."""
+    removed = 0
+    for name in os.listdir(dir_path):
+        if name == MANIFEST_NAME:
+            continue
+        try:
+            os.unlink(os.path.join(dir_path, name))
+            removed += 1
+        except OSError:
+            logger.warning("compile cache: could not purge stale entry "
+                           "%s", name)
+            raise  # a stale executable we cannot remove must disable
+    return removed
+
+
+def _validate_manifest(dir_path: str,
+                       lockfile_path: Optional[str]
+                       ) -> Tuple[Dict[str, Any], List[Tuple[str, dict]]]:
+    """Compare the cache directory's manifest against the live
+    committed lockfile; purge + classify on drift.  Returns the state
+    fields and the flight events to emit AFTER the configure lock is
+    released (the recorder never runs under the locks it observes)."""
+    import jax
+
+    from sparkdl_tpu.analysis.program.lockfile import (DEFAULT_LOCKFILE,
+                                                       diff_records,
+                                                       read_lockfile)
+
+    lock_path = lockfile_path or DEFAULT_LOCKFILE
+    programs: Dict[str, Any] = {}
+    if os.path.isfile(lock_path):
+        programs = read_lockfile(lock_path).get("programs", {})
+    manifest_path = os.path.join(dir_path, MANIFEST_NAME)
+    env = {"jax_version": jax.__version__,
+           "backend": jax.default_backend()}
+    reused = False
+    invalidated = False
+    drift_rules: List[str] = []
+    purged = 0
+    events: List[Tuple[str, dict]] = []
+    if os.path.isfile(manifest_path):
+        stored: Optional[Dict[str, Any]] = None
+        try:
+            with open(manifest_path, "r", encoding="utf-8") as fh:
+                stored = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            stored = None  # corrupt manifest == unprovable population
+        if (stored is not None
+                and stored.get("schema_version") == MANIFEST_SCHEMA
+                and stored.get("jax_version") == env["jax_version"]
+                and stored.get("backend") == env["backend"]
+                and _norm(stored.get("programs", {})) == _norm(programs)):
+            reused = True
+        else:
+            invalidated = True
+            if stored is not None and isinstance(
+                    stored.get("programs"), dict):
+                current = [{"name": n, **rec}
+                           for n, rec in sorted(programs.items())]
+                findings = diff_records(
+                    {"programs": stored["programs"]}, current)
+                drift_rules = sorted({f.code for f in findings})
+            purged = _purge(dir_path)
+            events.append(("compile.invalidate", {
+                "dir": dir_path, "purged_entries": purged,
+                "drift_rules": drift_rules or ["manifest"],
+            }))
+            logger.warning(
+                "persistent compile cache at %s invalidated: %s; purged "
+                "%d stale entries (fresh compiles ahead)", dir_path,
+                (f"lockfile drift classified {drift_rules}"
+                 if drift_rules else "unreadable/foreign manifest"),
+                purged)
+    doc = {"schema_version": MANIFEST_SCHEMA, **env, "programs": programs}
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, manifest_path)
+    fields = {"reused": reused, "invalidated": invalidated,
+              "drift_rules": drift_rules, "purged_entries": purged,
+              "lockfile_programs": len(programs), **env}
+    events.append(("compile.persist", {
+        "dir": dir_path, "reused": reused,
+        "lockfile_programs": len(programs)}))
+    return fields, events
+
+
+def _configure_locked(dir_path: Optional[str],
+                      lockfile_path: Optional[str]
+                      ) -> Tuple[Optional[Dict[str, Any]],
+                                 List[Tuple[str, dict]]]:
+    """Resolve the cache state (called under the configure lock);
+    returns (state, flight events to emit after release).  Any failure
+    degrades to DISABLED — the cache must never take down serving."""
+    if dir_path is None:
+        return None, []
+    try:
+        # chaos hook: an injected error here is a corrupt cache
+        # dir/manifest the configure path must absorb (degrade to
+        # fresh compiles), never propagate into engine construction
+        inject("compile.cache")
+        os.makedirs(dir_path, exist_ok=True)
+        fields, events = _validate_manifest(dir_path, lockfile_path)
+        import jax
+
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", dir_path)
+        # cold-start elimination wants EVERY dispatch program persisted,
+        # not only the slow-to-compile ones jax's defaults target
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _install_listener()
+        return {"dir": dir_path, **fields}, events
+    # graftlint: allow=SDL003 reason=the cache is an optimization: any configure failure (unwritable dir, corrupt manifest, injected fault) is logged and degrades to fresh compiles
+    except Exception as e:  # noqa: BLE001
+        logger.warning("persistent compile cache disabled: %s: %s "
+                       "(serving continues with fresh compiles)",
+                       type(e).__name__, e)
+        return None, []
+
+
+def configure(dir_path: Optional[str],
+              lockfile_path: Optional[str] = None
+              ) -> Optional[Dict[str, Any]]:
+    """Install (or disable, with ``None``) the persistent compile cache
+    at ``dir_path``, validating its manifest against ``lockfile_path``
+    (default: the committed ``PROGRAMS.lock.json``)."""
+    global _state
+    with _lock:
+        st, events = _configure_locked(dir_path, lockfile_path)
+        _state = st
+    for name, attrs in events:
+        flight_emit(name, **attrs)
+    return st
+
+
+def configure_from_env() -> Optional[Dict[str, Any]]:
+    """(Re-)configure from ``SPARKDL_COMPILE_CACHE``."""
+    return configure(dir_from_env())
+
+
+def ensure_from_env() -> Optional[Dict[str, Any]]:
+    """The per-engine probe: resolve ``SPARKDL_COMPILE_CACHE`` exactly
+    once per process (first engine construction), then one
+    module-global read forever after."""
+    global _state
+    st = _state
+    if st is not _UNSET:
+        return st
+    with _lock:
+        if _state is not _UNSET:
+            return _state
+        st, events = _configure_locked(dir_from_env(), None)
+        _state = st
+    for name, attrs in events:
+        flight_emit(name, **attrs)
+    return st
+
+
+def state() -> Optional[Dict[str, Any]]:
+    """The resolved cache state (None while disabled/unresolved) —
+    JSON-serializable; bench lines and the subprocess proof read it."""
+    st = _state
+    return dict(st) if isinstance(st, dict) else None
+
+
+def stats() -> Dict[str, int]:
+    """Persistent-cache hit/miss counters (jax.monitoring events) for
+    THIS process: a warm restart serving lockfile-pinned programs shows
+    ``misses == 0`` — the zero-fresh-compiles proof."""
+    return dict(_counts)
+
+
+def enabled() -> bool:
+    return isinstance(_state, dict)
+
+
+def _reset_for_tests() -> None:
+    """Forget the resolved state (tests re-resolve under a different
+    env); jax's own cache-dir config is cleared too so later engines
+    in this process stop persisting."""
+    global _state
+    with _lock:
+        _state = _UNSET
+        _counts["hits"] = 0
+        _counts["misses"] = 0
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001 — best-effort test cleanup
+        logger.info("compile cache reset: could not clear jax cache dir")

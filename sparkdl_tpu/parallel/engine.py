@@ -358,6 +358,15 @@ class InferenceEngine:
                  metrics: Optional[Metrics] = None):
         import jax
 
+        # Persistent compile cache (ISSUE 13): resolve the
+        # SPARKDL_COMPILE_CACHE knob once per process BEFORE any
+        # program of this engine compiles, so fleet deploys and
+        # serving cold-starts across restarts reuse on-disk
+        # executables keyed on the committed lockfile.  Disabled path
+        # = one module-global read.
+        from sparkdl_tpu.parallel import compile_cache
+
+        compile_cache.ensure_from_env()
         # Scoring is per-controller by design (PERF.md topology
         # envelope): each host scores its own rows on its own devices —
         # see resolve_engine_mesh (the zoo transformers pass no mesh, so

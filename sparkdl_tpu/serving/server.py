@@ -36,7 +36,8 @@ from sparkdl_tpu.obs.exemplar import ExemplarReservoir
 from sparkdl_tpu.obs.flight import emit as flight_emit
 from sparkdl_tpu.parallel.engine import CircuitOpenError
 from sparkdl_tpu.obs.trace import get_tracer
-from sparkdl_tpu.serving.batcher import DynamicBatcher, Request
+from sparkdl_tpu.serving.batcher import (DynamicBatcher, Request,
+                                         ragged_enabled_from_env)
 from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError,
                                         ServerClosedError,
@@ -254,6 +255,19 @@ class Server:
         every :meth:`health`/:meth:`varz` poll; a burn-rate breach
         degrades health (naming the objective in ``last_error``) and
         the evaluation rides ``health()["slo"]``.
+      * ``ragged`` — continuous ragged batching (ISSUE 13; default:
+        the ``SPARKDL_RAGGED`` env knob, ON): flushes cut the queue at
+        compiled-bucket boundaries (zero pad rows for the cut) and
+        sub-bucket residuals top off with stack-compatible late
+        arrivals right before dispatch, so the engine's pad path is
+        only paid for the true residual.  ``False`` restores the
+        flush-on-full baseline (everything waiting pads into the
+        nearest covering bucket).
+      * ``donate_batch`` — donate the per-dispatch device batch buffer
+        to XLA (None = auto: donate iff an eval-shape probe proves the
+        donation is CONSUMED — some output leaf aliases the batch;
+        zoo models resolve to False by recorded GC001 exemption, their
+        uint8 batch can never alias the float features).
     """
 
     def __init__(self, model, variables: Any = None, *,
@@ -277,12 +291,20 @@ class Server:
                  slos: Optional[Sequence[Any]] = None,
                  cache: Any = None,
                  cache_namespace: Optional[Sequence[Any]] = None,
+                 ragged: Optional[bool] = None,
+                 donate_batch: Optional[bool] = None,
                  metrics: Optional[Metrics] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
         if compute_dtype is None and output_host_dtype is None:
             compute_dtype = _overrides.get("compute_dtype")
             output_host_dtype = _overrides.get("output_host_dtype")
+        if donate_batch is None:
+            # zoo models override to False (uint8 batch can never alias
+            # the float features — GC001's recorded exemption); anything
+            # else stays None = probe per bucket at first dispatch
+            donate_batch = _overrides.get("donate_batch")
+        self._donate_batch = donate_batch
         self.metrics = metrics if metrics is not None else Metrics()
         self.max_batch_size = max(1, int(max_batch_size))
         # mesh-rounded, de-duplicated compiled shapes; also what the
@@ -340,9 +362,18 @@ class Server:
         self._engines: Dict[int, Any] = {}
         self._warm: set = set()  # buckets whose program is compiled
         self._engine_lock = named_lock("serving.engines")
+        # Continuous ragged batching (ISSUE 13): the batcher cuts
+        # flushes at this server's compiled bucket boundaries, and
+        # _execute tops a sub-bucket residual off with late arrivals
+        # right before stacking.  ``SPARKDL_RAGGED=0`` (or
+        # ``ragged=False``) restores the flush-on-full baseline.
+        self._ragged = (ragged_enabled_from_env() if ragged is None
+                        else bool(ragged))
         self._batcher = DynamicBatcher(
             max_batch_size=self.max_batch_size, max_wait_ms=max_wait_ms,
-            max_queue=max_queue, metrics=self.metrics)
+            max_queue=max_queue,
+            bucket_plan=self._buckets if self._ragged else None,
+            metrics=self.metrics)
         # Slow-request exemplars: top-K span trees, surfaced by varz();
         # inert (offer() returns False) unless SPARKDL_TRACE is on.
         self.exemplars = ExemplarReservoir(k=4)
@@ -364,13 +395,67 @@ class Server:
                 return b
         return self._buckets[-1]
 
-    def _engine_for(self, bucket: int):
+    def _probe_donate(self, bucket: int, batch_example: Any) -> bool:
+        """True iff XLA can actually CONSUME a donated batch buffer for
+        this server's fn at ``bucket`` rows: every batch leaf must find
+        a distinct output leaf with identical (shape, dtype) to alias
+        (GC001's consumption criterion, probed abstractly — one
+        ``eval_shape``, no compile).  Donating an unconsumable buffer
+        is harmless but noisy (XLA drops it with a warning), so the
+        auto path only declares what the audit would verify consumed.
+        Zoo models never reach here (their uint8 batch can never alias
+        the float features — the recorded GC001 exemption rides the
+        ``zoo_serving_bundle`` engine overrides as
+        ``donate_batch=False``)."""
+        import jax
+
+        from collections import Counter
+
+        try:
+            cdt = self._compute_dtype
+
+            def var_aval(leaf):
+                arr = leaf if hasattr(leaf, "dtype") else np.asarray(leaf)
+                dt = arr.dtype
+                if cdt is not None and np.issubdtype(dt, np.floating):
+                    dt = cdt  # mirror the engine's _cast_floating
+                return jax.ShapeDtypeStruct(tuple(arr.shape), dt)
+
+            variables = jax.tree_util.tree_map(var_aval,
+                                               self._host_variables)
+            avals = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    (bucket,) + tuple(a.shape[1:]), a.dtype),
+                batch_example)
+            out = jax.eval_shape(self._fn, variables, avals)
+            need = Counter((tuple(l.shape), np.dtype(l.dtype))
+                           for l in jax.tree_util.tree_leaves(avals))
+            have = Counter((tuple(l.shape), np.dtype(l.dtype))
+                           for l in jax.tree_util.tree_leaves(out))
+            return all(have[k] >= c for k, c in need.items())
+        except Exception as e:  # noqa: BLE001 — probe must never break serving
+            logger.info("donation probe failed (%s: %s); building bucket "
+                        "%d without batch donation", type(e).__name__, e,
+                        bucket)
+            return False
+
+    def _engine_for(self, bucket: int, batch_example: Any = None):
         with self._engine_lock:
             eng = self._engines.get(bucket)
             if eng is None:
                 from sparkdl_tpu.parallel.engine import InferenceEngine
 
                 first = next(iter(self._engines.values()), None)
+                donate = self._donate_batch
+                if donate is None:
+                    # auto: donate the per-dispatch device batch iff the
+                    # probe proves XLA will alias it into an output
+                    # (ISSUE 13 satellite — the engine device_puts a
+                    # fresh buffer per dispatch and never touches it
+                    # again, so donation is always SAFE; the probe only
+                    # decides whether it is CONSUMED)
+                    donate = (self._probe_donate(bucket, batch_example)
+                              if batch_example is not None else False)
                 # Buckets share ONE device copy of the weights (device_put
                 # of an already-replicated pytree is a no-op) and ONE jit
                 # program (module-level engine cache keyed on fn/mesh) —
@@ -384,6 +469,7 @@ class Server:
                     compute_dtype=(None if first is not None
                                    else self._compute_dtype),
                     output_host_dtype=self._output_host_dtype,
+                    donate_batch=bool(donate),
                     dispatch_retries=self._dispatch_retries,
                     breaker_threshold=self._breaker_threshold,
                     breaker_cooldown_s=self._breaker_cooldown_s,
@@ -402,9 +488,12 @@ class Server:
             example = self._host_preprocess(example)
         example = jax.tree_util.tree_map(np.asarray, example)
         for b in self._buckets:
-            eng = self._engine_for(b)
+            # buckets are mesh-rounded already (bucket_plan), so the
+            # bucket IS the engine's device batch; stacking first lets
+            # _engine_for's donation probe see the real batch aval
             stacked = jax.tree_util.tree_map(
-                lambda a: np.stack([a] * eng.device_batch_size), example)
+                lambda a: np.stack([a] * b), example)
+            eng = self._engine_for(b, stacked)
             eng(stacked)
             self._warm.add(b)
 
@@ -750,16 +839,57 @@ class Server:
             attempt_done.set()
             timer.cancel()
 
+    def _top_off(self, gap: int, bucket: int, base: int,
+                 like: Any) -> List[Request]:
+        """The continuous half of ragged batching (ISSUE 13): right
+        before a sub-bucket batch stacks, pull up to ``gap`` requests
+        that arrived since the flush decision — they ride pad rows the
+        dispatch was about to waste.  The ``batch.topoff`` fault site
+        covers the pull: top-off is an OPTIMIZATION, so an injected
+        failure degrades to the baseline padding (nobody is lost, the
+        base batch still dispatches) instead of failing the batch."""
+        try:
+            inject("batch.topoff")
+        # graftlint: allow=SDL003 reason=chaos contract: a failed top-off pull degrades to baseline padding (logged); the base batch must still dispatch
+        except Exception as e:  # noqa: BLE001
+            logger.warning("batch.topoff aborted: %s: %s; dispatching at "
+                           "base fill %d/%d", type(e).__name__, e, base,
+                           bucket)
+            self.metrics.incr("serving.topoff_aborted")
+            return []
+        extras = self._batcher.top_off(gap, like=like)
+        if extras:
+            self.metrics.incr("serving.topoffs")
+            self.metrics.incr("serving.topoff_rows", len(extras))
+            flight_emit("batch.topoff", rows=len(extras), base=base,
+                        bucket=bucket)
+        return extras
+
     def _execute(self, requests: List[Request], finish: _Once) -> None:
         import jax
 
         n = len(requests)
+        bucket = self._bucket_for(n)
+        if self._ragged and n < bucket and len(
+                {DynamicBatcher._payload_signature(r.payload)
+                 for r in requests}) == 1:
+            # top off only when the WHOLE base batch stacks: a flush can
+            # legitimately pop mixed shapes (that batch is doomed to
+            # fail its own stack — baseline behavior), and pulling a
+            # healthy late arrival into it would widen the failure's
+            # blast radius beyond what the flush policy dealt
+            extras = self._top_off(bucket - n, bucket, n,
+                                   requests[0].payload)
+            if extras:
+                # extend IN PLACE: _run_batch's error handler and the
+                # stall watchdog hold this same list — a topped-off
+                # request must be settled by every failure path too
+                requests.extend(extras)
+                n = len(requests)
         now = time.monotonic()
         for r in requests:
             self.metrics.record_time("serving.time_in_queue",
                                      now - r.enqueued_at)
-        bucket = self._bucket_for(n)
-        eng = self._engine_for(bucket)
         # Dispatch rides the same engine entrypoint as the offline stack
         # (parallel.pipeline): a micro-batch is a single device batch, so
         # the engine's single-piece fast path applies (no thread hop on
@@ -769,6 +899,7 @@ class Server:
         stacked = jax.tree_util.tree_map(
             lambda *rows: np.stack(rows, axis=0),
             *[r.payload for r in requests])
+        eng = self._engine_for(bucket, stacked)
         if self._dispatch_timeout_s is not None and bucket not in self._warm:
             # compile OUTSIDE the watchdog window: the first call to a
             # bucket jits the program (seconds for real models), which
@@ -923,6 +1054,7 @@ class Server:
                 "closed": self._closed,
                 "max_batch_size": self.max_batch_size,
                 "bucket_sizes": list(self._buckets),
+                "ragged": self._ragged,
                 "queue_depth": self.queue_depth(),
                 "inflight_batches": self._inflight,
             },

@@ -110,13 +110,18 @@ def zoo_serving_bundle(name: str, featurize: bool):
     version reuse the compiled executable instead of re-jitting."""
     module, zoo_vars = _cached_model(name)
     cdt = None
-    overrides: Dict[str, object] = {}
+    # GC001's recorded zoo exemption, enforced where the engines are
+    # built: the uint8 image batch can never alias the float feature
+    # output, so declaring the donation would only make XLA drop it —
+    # the serving auto-donation probe must not even try
+    # (analysis.program.inventory.ZOO_DONATE_REASON).
+    overrides: Dict[str, object] = {"donate_batch": False}
     if zoo_compute_dtype_name() == "bfloat16":
         import jax.numpy as jnp
 
         cdt = jnp.bfloat16
-        overrides = {"compute_dtype": jnp.bfloat16,
-                     "output_host_dtype": np.float32}
+        overrides.update({"compute_dtype": jnp.bfloat16,
+                          "output_host_dtype": np.float32})
     fn = zoo_model_fn(name, featurize=featurize, compute_dtype=cdt,
                       module=module)
     return fn, zoo_vars, overrides

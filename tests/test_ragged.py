@@ -1,0 +1,355 @@
+"""Continuous ragged batching (ISSUE 13): bucket-boundary flush cuts,
+late-arrival top-off, the pad-row-reduction benchmark, and the edges —
+deadline shed inside a partially-formed ragged batch, exactly-full vs
+one-over top-off, cross-tenant coalescing under per-tenant admission
+charges, and the SPARKDL_CACHE hit-probe ordering staying ahead of the
+(ragged) admission path.  Everything is CPU-deterministic: flush math
+is driven synchronously at the batcher layer, and the one timed server
+test holds the dispatch worker open with an injected ``batch.topoff``
+sleep so the top-off window is wide, not raced.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import faults
+from sparkdl_tpu.serving.batcher import (DynamicBatcher, Request,
+                                         ragged_arrival_benchmark,
+                                         ragged_enabled_from_env)
+from sparkdl_tpu.serving.errors import (DeadlineExceededError,
+                                        QueueFullError)
+from sparkdl_tpu.serving.server import Server
+
+
+def _fn(v, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x * v["s"] + 0.25)
+
+
+VARS = {"s": np.float32(2.0)}
+
+
+def _rows(n, dim=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(dim,)).astype(np.float32) for _ in range(n)]
+
+
+# -- batcher-level flush cuts ----------------------------------------------
+
+def test_ragged_flush_cuts_at_bucket_boundaries():
+    b = DynamicBatcher(max_batch_size=32, max_wait_ms=1.0,
+                       bucket_plan=[8, 16, 32])
+    for r in _rows(20):
+        b.submit(Request(r))
+    first = b.next_batch()
+    second = b.next_batch()
+    # 20 waiting -> a zero-pad cut of 16, then the true residual of 4
+    assert [len(first), len(second)] == [16, 4]
+
+
+def test_ragged_flush_caps_at_max_batch_size():
+    # mesh-rounded buckets can exceed the configured batch; the flush
+    # cut must still honor the baseline's max_batch_size contract
+    b = DynamicBatcher(max_batch_size=4, max_wait_ms=1.0,
+                       bucket_plan=[8])
+    for r in _rows(6):
+        b.submit(Request(r))
+    assert len(b.next_batch()) == 4
+    assert len(b.next_batch()) == 2
+
+
+def test_ragged_residual_below_smallest_bucket_flushes_whole():
+    b = DynamicBatcher(max_batch_size=32, max_wait_ms=1.0,
+                       bucket_plan=[8, 16, 32])
+    for r in _rows(5):
+        b.submit(Request(r))
+    assert len(b.next_batch()) == 5  # sub-bucket: pad is the true floor
+
+
+def test_urgent_deadline_beyond_cut_rides_this_flush():
+    b = DynamicBatcher(max_batch_size=32, max_wait_ms=10_000.0,
+                       bucket_plan=[8, 16, 32])
+    reqs = [Request(r) for r in _rows(20)]
+    # index 18 would be left behind by the plain 16-cut; its deadline
+    # is already inside the guard window, so the cut must grow
+    reqs[18].deadline = time.monotonic() + 5e-3
+    for r in reqs:
+        b.submit(r)
+    batch = b.next_batch()
+    assert len(batch) == 20  # min(depth, smallest bucket covering #18)
+    assert reqs[18] in batch
+
+
+# -- top-off ---------------------------------------------------------------
+
+def test_top_off_exactly_full_vs_one_over():
+    b = DynamicBatcher(max_batch_size=8, max_wait_ms=1.0,
+                       bucket_plan=[8])
+    for r in _rows(9):
+        b.submit(Request(r))
+    batch = b.next_batch()
+    assert len(batch) == 8           # exactly one full bucket
+    late = b.top_off(0, like=batch[0].payload)
+    assert late == []                # exactly-full: nothing to pull
+    residual = b.next_batch()
+    assert len(residual) == 1        # the one-over remainder
+    for r in _rows(3, seed=7):
+        b.submit(Request(r))
+    pulled = b.top_off(7, like=residual[0].payload)
+    assert len(pulled) == 3          # tops the residual toward its bucket
+
+
+def test_top_off_stops_at_stack_incompatible_payload():
+    b = DynamicBatcher(max_batch_size=8, max_wait_ms=1.0,
+                       bucket_plan=[8])
+    base = Request(np.zeros((6,), np.float32))
+    b.submit(Request(np.zeros((6,), np.float32)))
+    poison = Request(np.zeros((7,), np.float32))  # different shape
+    b.submit(poison)
+    b.submit(Request(np.zeros((6,), np.float32)))  # behind the poison
+    pulled = b.top_off(8, like=base.payload)
+    # FIFO preserved: the pull stops AT the poison — it neither rides a
+    # batch it cannot stack into nor is skipped over (no reordering)
+    assert len(pulled) == 1
+    assert b.depth() == 2
+    assert not poison.future.done()
+
+
+def test_deadline_shed_inside_partially_formed_ragged_batch():
+    b = DynamicBatcher(max_batch_size=8, max_wait_ms=1.0,
+                       bucket_plan=[8])
+    live1 = Request(np.zeros((6,), np.float32))
+    expired = Request(np.zeros((6,), np.float32),
+                      deadline=time.monotonic() - 1e-3)
+    live2 = Request(np.zeros((6,), np.float32))
+    for r in (live1, expired, live2):
+        b.submit(r)
+    pulled = b.top_off(8, like=live1.payload)
+    # the expired request is shed by the pull exactly like a flush
+    # would shed it: it never pads a dispatch, its future fails now
+    assert pulled == [live1, live2]
+    with pytest.raises(DeadlineExceededError):
+        expired.future.result(timeout=1)
+    assert b.metrics.counters["serving.shed_deadline"] == 1
+
+
+def test_server_top_off_fills_forming_batch(tmp_path):
+    """The continuous half end-to-end: a sub-bucket flush forms, the
+    injected ``batch.topoff`` sleep holds the worker BEFORE its pull,
+    late arrivals land, and the pull absorbs them — one full-bucket
+    dispatch, fill 1.0, zero pad rows for the late arrivals."""
+    rows = _rows(8)
+    plan = faults.FaultPlan.parse(
+        "seed=13;batch.topoff:sleep:ms=250,times=1")
+    # max_wait is LONG (the late arrivals must stay queued instead of
+    # age-flushing into their own batch while the worker sleeps); the
+    # early requests carry a deadline so the deadline guard flushes
+    # them promptly into the forming batch
+    with Server(_fn, VARS, max_batch_size=8, max_wait_ms=2_000,
+                bucket_sizes=[8], max_inflight_batches=1,
+                cache=False) as srv:
+        srv.warmup(rows[0])
+        with faults.active(plan):
+            early = [srv.submit(r, timeout_ms=60) for r in rows[:3]]
+            time.sleep(0.1)  # flush fired; worker asleep in top-off
+            late = [srv.submit(r) for r in rows[3:]]
+            outs = [np.asarray(f.result(timeout=60))
+                    for f in early + late]
+        s = srv.metrics.summary()
+    assert s["serving.batches"] == 1          # ONE dispatch for all 8
+    assert s["serving.topoff_rows"] == 5
+    eng_rows = s["engine.rows"] - 8           # minus the warmup batch
+    assert eng_rows == 8
+    # warmup padded nothing and neither did the topped-off batch
+    assert s.get("engine.pad_rows", 0) == 0
+    expect = [np.tanh(r * 2.0 + 0.25) for r in rows]
+    for got, want in zip(outs, expect):
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_injected_topoff_error_degrades_to_baseline_padding():
+    rows = _rows(3)
+    plan = faults.FaultPlan.parse("seed=13;batch.topoff:error:times=1")
+    with Server(_fn, VARS, max_batch_size=8, max_wait_ms=10,
+                bucket_sizes=[8], cache=False) as srv:
+        with faults.active(plan):
+            outs = [np.asarray(srv.submit(r).result(timeout=60))
+                    for r in rows]
+        s = srv.metrics.summary()
+    # the pull aborted but the base batch still dispatched (padded)
+    assert s["serving.topoff_aborted"] >= 1
+    assert s["serving.completed"] == 3
+    for got, r in zip(outs, rows):
+        np.testing.assert_allclose(got, np.tanh(r * 2.0 + 0.25),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_mixed_shape_base_batch_never_pulls_healthy_arrivals():
+    """Review regression: a flush can legitimately pop MIXED payload
+    shapes into one (doomed) batch; top-off must then pull nothing —
+    a healthy late arrival must not die with a batch it could never
+    stack into (the baseline would have served it in its own batch)."""
+    good = np.zeros((6,), np.float32)
+    poison = np.zeros((7,), np.float32)
+    plan = faults.FaultPlan.parse(
+        "seed=13;batch.topoff:sleep:ms=200,times=1")
+    with Server(_fn, VARS, max_batch_size=8, max_wait_ms=2_000,
+                bucket_sizes=[8], max_inflight_batches=1,
+                cache=False) as srv:
+        with faults.active(plan):
+            # the deadline guard flushes these TWO mixed shapes together
+            doomed = [srv.submit(good, timeout_ms=60),
+                      srv.submit(poison, timeout_ms=60)]
+            time.sleep(0.1)  # mixed batch formed; worker held in top-off
+            healthy = srv.submit(good)
+            for f in doomed:
+                with pytest.raises(Exception):
+                    f.result(timeout=30)
+            out = np.asarray(healthy.result(timeout=30))
+    np.testing.assert_allclose(out, np.tanh(good * 2.0 + 0.25),
+                               rtol=1e-6, atol=1e-6)
+
+
+# -- knobs / wiring --------------------------------------------------------
+
+def test_sparkdl_ragged_env_knob(monkeypatch):
+    monkeypatch.delenv("SPARKDL_RAGGED", raising=False)
+    assert ragged_enabled_from_env() is True
+    for off in ("0", "false", "off", "no"):
+        monkeypatch.setenv("SPARKDL_RAGGED", off)
+        assert ragged_enabled_from_env() is False
+    monkeypatch.setenv("SPARKDL_RAGGED", "1")
+    assert ragged_enabled_from_env() is True
+
+
+def test_server_ragged_wiring():
+    with Server(_fn, VARS, max_batch_size=8, bucket_sizes=[8],
+                cache=False) as on:
+        assert on._batcher.bucket_plan == on.bucket_sizes
+        assert on.varz()["server"]["ragged"] is True
+    with Server(_fn, VARS, max_batch_size=8, bucket_sizes=[8],
+                ragged=False, cache=False) as off:
+        assert off._batcher.bucket_plan is None
+        assert off.varz()["server"]["ragged"] is False
+
+
+def test_donation_probe_declares_consumable_donation_only():
+    """The serving auto-donation (ISSUE 13 satellite): a square float
+    fn's batch aliases its output — the engine must declare the
+    donation (GC001's consumed criterion, audited in the lockfile's
+    serving/generic program); a non-aliasable output shape must leave
+    donation OFF (no declared-then-dropped noise)."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    square = {"w": rng.normal(size=(8, 8)).astype(np.float32)}
+    narrow = {"w": rng.normal(size=(8, 2)).astype(np.float32)}
+
+    def mat(v, x):
+        import jax.numpy as jnp
+
+        return jnp.tanh(x @ v["w"])
+
+    def aliasing(srv, variables):
+        row = rng.normal(size=(8,)).astype(np.float32)
+        srv.warmup(row)
+        eng = srv._engine_for(srv.bucket_sizes[0])
+        av = {"w": jax.ShapeDtypeStruct(variables["w"].shape, np.float32)}
+        batch = jax.ShapeDtypeStruct((eng.device_batch_size, 8),
+                                     np.float32)
+        return eng._compiled.lower(av, batch).as_text().count(
+            "tf.aliasing_output")
+
+    with Server(mat, square, max_batch_size=8, bucket_sizes=[8],
+                cache=False) as srv:
+        assert aliasing(srv, square) == 1   # donated AND consumed
+    with Server(mat, narrow, max_batch_size=8, bucket_sizes=[8],
+                cache=False) as srv:
+        assert aliasing(srv, narrow) == 0   # probe kept donation off
+
+
+# -- cross-tenant coalescing (fleet path) ----------------------------------
+
+def test_cross_tenant_coalescing_respects_admission_charges():
+    """Sub-bucket remainders from DIFFERENT tenants coalesce into one
+    ragged dispatch (they share the version's server queue), while the
+    admission layer still charges each tenant individually — and a
+    zero-quota tenant is shed, never coalesced."""
+    from sparkdl_tpu.serving.fleet import Fleet, TenantQuota
+    from sparkdl_tpu.serving.errors import QuotaExceededError
+
+    rows = _rows(8)
+    with Fleet(quotas={"a": TenantQuota(rate_per_s=100.0, burst=8),
+                       "b": TenantQuota(rate_per_s=100.0, burst=8),
+                       "nobody": TenantQuota(rate_per_s=0.0)},
+               max_batch_size=8, max_wait_ms=40, bucket_sizes=[8],
+               cache=False) as fleet:
+        fleet.add_model("m", _fn, VARS, warm_example=rows[0])
+        futs = [fleet.submit("m", rows[i], tenant="a") for i in range(5)]
+        futs += [fleet.submit("m", rows[i], tenant="b")
+                 for i in range(5, 8)]
+        with pytest.raises(QuotaExceededError):
+            fleet.submit("m", rows[0], tenant="nobody")
+        outs = [np.asarray(f.result(timeout=60)) for f in futs]
+        state = fleet._models["m"]
+        s = state.server.metrics.summary()
+        tenants = fleet.varz()["tenants"]
+    # one coalesced full-bucket dispatch carried BOTH tenants' rows
+    assert s["serving.batches"] == 1
+    assert s.get("engine.pad_rows", 0) == 0
+    assert tenants["a"]["completed"] == 5
+    assert tenants["b"]["completed"] == 3
+    # the zero-quota shed never reached a server queue (it raised at
+    # the admission gate, before any coalescing could see it)
+    assert "nobody" not in tenants
+    for got, r in zip(outs, rows):
+        np.testing.assert_allclose(got, np.tanh(r * 2.0 + 0.25),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# -- cache probe ordering --------------------------------------------------
+
+def test_cache_hit_probe_still_ahead_of_ragged_admission():
+    """ISSUE 13 edge: the SPARKDL_CACHE hit probe runs BEFORE the
+    admission-queue charge, ragged or not — a cached payload serves
+    even while the queue is at capacity."""
+    from sparkdl_tpu.serving.cache import InferenceCache, example_digest
+
+    rows = _rows(3, seed=11)
+    cache = InferenceCache()
+    ns = ("t", "probe-order")
+    hot = rows[0]
+    want = np.tanh(hot * 2.0 + 0.25).astype(np.float32)
+    cache.put(ns + (example_digest(hot),), want)
+    srv = Server(_fn, VARS, max_batch_size=8, max_wait_ms=10_000,
+                 max_queue=1, bucket_sizes=[8], cache=cache,
+                 cache_namespace=ns)
+    try:
+        srv.submit(rows[1])              # occupies the 1-slot queue
+        with pytest.raises(QueueFullError):
+            srv.submit(rows[2])          # admission is genuinely full
+        got = np.asarray(srv.submit(hot).result(timeout=5))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        assert srv.metrics.counters["serving.cache_hits"] == 1
+    finally:
+        srv.close(drain=False)
+
+
+# -- the headline benchmark ------------------------------------------------
+
+def test_ragged_arrival_benchmark_headline():
+    """The acceptance guard: a seeded mixed-size arrival replay over a
+    sleep-wrapped Server measures a pad-row REDUCTION (the engine's
+    rows/pad_rows ledger) vs the flush-on-full baseline, with
+    bit-identical per-request outputs and a higher mean fill ratio."""
+    res = ragged_arrival_benchmark(n_bursts=6, gap_ms=60.0,
+                                   dispatch_ms=5.0)
+    assert res["bit_identical"], res
+    assert res["ragged"]["rows"] == res["flush"]["rows"] == \
+        res["n_requests"]
+    assert res["pad_rows_saved"] > 0, res
+    assert res["ragged"]["pad_rows"] < res["flush"]["pad_rows"], res
+    assert res["ragged"]["fill_mean"] > res["flush"]["fill_mean"], res

@@ -208,7 +208,7 @@ def test_transient_failure_retried_through_utils_retry(setup, monkeypatch):
                 return self._eng(batch)
 
         monkeypatch.setattr(srv, "_engine_for",
-                            lambda b: Flaky(real_engine_for(b)))
+                            lambda b, ex=None: Flaky(real_engine_for(b)))
         futs = [srv.submit(x[i]) for i in range(4)]
         for f in futs:
             np.asarray(f.result(timeout=60))
@@ -244,7 +244,7 @@ def test_stalled_batch_times_out_and_later_batches_proceed(setup,
                 return self._eng(batch)
 
         monkeypatch.setattr(srv, "_engine_for",
-                            lambda b: Stalls(real_engine_for(b)))
+                            lambda b, ex=None: Stalls(real_engine_for(b)))
         stuck = [srv.submit(x[i]) for i in range(2)]
         for f in stuck:
             with pytest.raises(DispatchTimeoutError):
@@ -293,10 +293,13 @@ def test_abandoned_close_settles_undispatched_futures(setup, monkeypatch):
                 return self._eng(batch)
 
         monkeypatch.setattr(srv, "_engine_for",
-                            lambda b: Wedge(real_engine_for(b)))
+                            lambda b, ex=None: Wedge(real_engine_for(b)))
         wedged = [srv.submit(x[i]) for i in range(2)]   # dispatches, hangs
+        time.sleep(0.2)  # let the wedged batch ENTER the model call —
+        # submitted any earlier, the ragged top-off would legitimately
+        # pull the next requests into the forming batch before dispatch
         parked = [srv.submit(x[i]) for i in range(2)]   # blocked behind it
-        time.sleep(0.2)  # let the wedged batch start
+        time.sleep(0.1)
         srv.close(drain=True, timeout_s=0.5)
         for f in parked:
             with pytest.raises(ServerClosedError):
@@ -474,7 +477,9 @@ def test_named_model_honors_zoo_compute_dtype(monkeypatch):
     assert ov["output_host_dtype"] == np.float32
     monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "float32")
     _, _, ov = server_mod._resolve_model("FakeZoo", None, True)
-    assert ov == {}
+    # zoo overrides always pin donation OFF (the recorded GC001
+    # exemption: a uint8 batch can never alias the float features)
+    assert ov == {"donate_batch": False}
     monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bogus")
     with pytest.raises(ValueError, match="not supported"):
         server_mod._resolve_model("FakeZoo", None, True)
