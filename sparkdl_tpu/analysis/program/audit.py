@@ -74,9 +74,15 @@ class ProgramSpec:
     batch_rows: Optional[int] = None      # padded rows per dispatch
     # per-arg sharding declaration: "replicated" | "batch" (data axis on
     # dim 0) | "stacked_batch" (the grouped/multi-step layout — data
-    # axis on dim 1) | None
+    # axis on dim 1) | "params" (per-leaf partition specs — ISSUE 14
+    # tensor-parallel weights; see ``param_partition``) | None
     shardings: Optional[Tuple[Optional[str], ...]] = None
     mesh_axes: Optional[Dict[str, int]] = None   # {"data": 8, "model": 1}
+    # the "params" arg's declared layout: ((path, spec_json), ...) where
+    # spec_json is the per-dim axis list (mesh.spec_to_json) — leaves
+    # with an empty spec count as replicated in the GC005 byte budget,
+    # sharded leaves contribute bytes/shards per chip and must divide
+    param_partition: Optional[Tuple] = None
     # retrace-audit group: one compiled fn identity (GC003 groups shapes
     # under it the way jax's executable cache would)
     group: Optional[str] = None
@@ -216,30 +222,119 @@ def audit_program(spec: ProgramSpec) -> Dict[str, Any]:
     return {"record": record, "findings": findings}
 
 
+def _leaf_bytes(leaf) -> int:
+    import numpy as np
+
+    return int(np.prod(leaf.shape, dtype=np.int64)
+               * np.dtype(leaf.dtype).itemsize)
+
+
+def _spec_shard_count(spec_json, mesh_axes: Dict[str, int]) -> int:
+    """How many ways a spec_json dim list splits its leaf (product of
+    the named mesh axis sizes; 1 = replicated)."""
+    shards = 1
+    for entry in spec_json or ():
+        if entry is None:
+            continue
+        for axis in (entry if isinstance(entry, (list, tuple)) else (entry,)):
+            shards *= int(mesh_axes.get(str(axis), 1))
+    return shards
+
+
 def _sharding_summary(spec: ProgramSpec, args: tuple,
                       text: str) -> Optional[Dict[str, Any]]:
     if spec.shardings is None:
         return None
-    import numpy as np
 
     replicated_bytes = 0
     largest_leaf = 0
     batch_args = []
+    param_shards: Optional[Dict[str, Any]] = None
     for i, kind in enumerate(spec.shardings):
         if kind in ("batch", "stacked_batch"):
             batch_args.append((i, 0 if kind == "batch" else 1))
         elif kind == "replicated":
             for leaf in _tree_leaves(args[i]):
-                size = int(np.prod(leaf.shape, dtype=np.int64)
-                           * np.dtype(leaf.dtype).itemsize)
+                size = _leaf_bytes(leaf)
                 replicated_bytes += size
                 largest_leaf = max(largest_leaf, size)
-    return {
+        elif kind == "params":
+            # tensor-parallel weights (ISSUE 14): replicated leaves
+            # (empty spec) join the byte budget above; sharded leaves
+            # cost bytes/shards per chip and their split dims must
+            # divide (audited by GC005 via "indivisible" below)
+            import jax
+
+            from sparkdl_tpu.parallel.mesh import param_path_str
+
+            spec_map = dict(spec.param_partition or ())
+            axes = spec.mesh_axes or {}
+            sharded_bytes = 0
+            sharded_leaves = 0
+            indivisible = []
+            flat, _ = jax.tree_util.tree_flatten_with_path(args[i])
+            for path, leaf in flat:
+                name = param_path_str(path)
+                sj = spec_map.get(name) or ()
+                size = _leaf_bytes(leaf)
+                # an axis name absent from the declared mesh is a
+                # declaration that matches NO real layout — flagged,
+                # and the leaf is EXCLUDED from both byte budgets (its
+                # intended layout is unknowable, and folding it into
+                # the replicated budget would stack a misleading
+                # "shard it with a PartitionSpec" finding on top of
+                # the typo finding that already names the fix)
+                unknown = False
+                for dim, entry in enumerate(sj):
+                    if entry is None:
+                        continue
+                    names = (entry if isinstance(entry, (list, tuple))
+                             else (entry,))
+                    for axis in names:
+                        if str(axis) not in axes:
+                            unknown = True
+                            indivisible.append(
+                                {"param": name, "dim": dim,
+                                 "shape": list(leaf.shape), "shards": 0,
+                                 "unknown_axis": str(axis)})
+                if unknown:
+                    continue
+                shards = _spec_shard_count(sj, axes)
+                if shards <= 1:
+                    replicated_bytes += size
+                    largest_leaf = max(largest_leaf, size)
+                    continue
+                sharded_leaves += 1
+                sharded_bytes += size // shards
+                for dim, entry in enumerate(sj):
+                    n = _spec_shard_count([entry], axes)
+                    if n > 1 and (dim >= len(leaf.shape)
+                                  or leaf.shape[dim] % n):
+                        indivisible.append(
+                            {"param": name, "dim": dim,
+                             "shape": list(leaf.shape), "shards": n})
+            # accumulate across MULTIPLE "params" args (e.g. separate
+            # frozen/trainable collections): a second arg must add to
+            # — never replace — the first's accounting and findings
+            if param_shards is None:
+                param_shards = {"specs": [], "sharded_leaves": 0,
+                                "sharded_bytes_per_chip": 0,
+                                "indivisible": []}
+            param_shards["specs"] = sorted(
+                param_shards["specs"]
+                + [(n, list(sj)) for n, sj in spec_map.items()])
+            param_shards["sharded_leaves"] += sharded_leaves
+            param_shards["sharded_bytes_per_chip"] += sharded_bytes
+            param_shards["indivisible"].extend(indivisible)
+    summary: Dict[str, Any] = {
         "batch_args": batch_args,
         "replicated_bytes": replicated_bytes,
         "largest_replicated_leaf_bytes": largest_leaf,
         "annotated": text.count("mhlo.sharding"),
     }
+    if param_shards is not None:
+        summary["param_shards"] = param_shards
+    return summary
 
 
 def _rule_gc001(spec: ProgramSpec, record: Dict[str, Any]) -> List[Finding]:
@@ -309,8 +404,26 @@ def _rule_gc005(spec: ProgramSpec, record: Dict[str, Any], args: tuple,
             "GC005", spec.name, 0,
             f"param leaf of {mb:.0f} MB fully replicated although the "
             f"mesh has a {model}-way model axis — shard it with a "
-            f"PartitionSpec (parallel.train param_specs) instead of "
-            f"paying {model}x HBM"))
+            f"PartitionSpec (mesh.match_partition_rules / parallel.train "
+            f"param_specs) instead of paying {model}x HBM"))
+    shards = summary.get("param_shards")
+    if shards:
+        for bad in shards["indivisible"]:
+            if bad.get("unknown_axis"):
+                findings.append(Finding(
+                    "GC005", spec.name, 0,
+                    f"sharded param {bad['param']!r} dim {bad['dim']} "
+                    f"names unknown mesh axis {bad['unknown_axis']!r} "
+                    f"(declared axes: {sorted(spec.mesh_axes or {})}) "
+                    f"— the declaration matches no real layout"))
+                continue
+            findings.append(Finding(
+                "GC005", spec.name, 0,
+                f"sharded param {bad['param']!r} dim {bad['dim']} "
+                f"(shape {tuple(bad['shape'])}) not divisible by its "
+                f"{bad['shards']}-way split — the layout recompiles or "
+                f"fails at device_put (mesh.resolve_param_shardings "
+                f"would have replicated this leaf)"))
     return findings
 
 

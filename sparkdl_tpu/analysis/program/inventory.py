@@ -230,6 +230,108 @@ def generic_dispatch_specs(feature_dim: int = 16,
         group="serving/generic/tanh_linear/f32")]
 
 
+#: the wide-dense proof model's shape: a (in, out) f32 kernel of 64 MB
+#: — over the 32 MB GC005 replicated budget — with a SMALL contraction
+#: dim and a WIDE output dim, so the tensor-parallel split (output
+#: columns across the model axis) leaves every output element's
+#: accumulation order untouched and sharded serving is BIT-IDENTICAL
+#: to the single-device replicated oracle (tests pin this at runtime)
+WIDE_DENSE_IN = 128
+WIDE_DENSE_OUT = 131072
+
+
+def sharded_dispatch_specs(feature_dim_in: int = WIDE_DENSE_IN,
+                           feature_dim_out: int = WIDE_DENSE_OUT,
+                           batch_rows: int = 32) -> List[ProgramSpec]:
+    """The tensor-parallel dispatch programs (ISSUE 14): a synthetic
+    WIDE-DENSE head whose single kernel (128 x 131072 f32 = 64 MB at
+    the defaults) busts graftcheck's 32 MB replicated-param budget on
+    any model-axis mesh — the smallest model that PROVES the HBM claim
+    chip-free.  Each spec builds through the same
+    ``build_dispatch_jit(param_shardings=...)`` constructor the engine
+    uses, with the layout from ``mesh.resolve_param_shardings`` under
+    the default rules (kernel split on its output dim, bias/scalars
+    replicated), on the model-axis meshes the 8-virtual-device audit
+    topology supports: ``dp1tp8`` (pure tensor parallel) and
+    ``dp2tp4`` (mixed).  GC005 then verifies the claim: no replicated
+    leaf above budget (the kernel now costs bytes/model_axis per
+    chip), every split dim divides, mhlo.sharding present — where the
+    same program under ``shardings=("replicated", "batch")`` is the
+    budget-buster negative fixture the tests pin.  The batch is
+    donated (f32 in, f32 out — but note the output is WIDER than the
+    batch, so XLA cannot alias it; the recorded reason below is the
+    GC001 exemption, symmetric to the zoo's uint8 one)."""
+    import jax
+
+    from sparkdl_tpu.parallel import mesh as mesh_lib
+    from sparkdl_tpu.parallel.engine import effective_device_batch
+
+    n = len(jax.devices())
+    layouts = [n]  # pure TP: (1, n)
+    if n >= 4 and n % 2 == 0:
+        layouts.append(n // 2)  # mixed: (2, n/2)
+    specs: List[ProgramSpec] = []
+    for model_parallel in layouts:
+        if model_parallel < 2 or feature_dim_out % model_parallel:
+            continue
+        mesh = mesh_lib.get_mesh(model_parallel=model_parallel)
+        axes = _mesh_axes(mesh)
+        b = effective_device_batch(batch_rows, mesh)
+        # the default-rule layout, spelled statically so the declaration
+        # cannot drift from what build() resolves
+        kernel_spec = mesh_lib.spec_to_json(
+            jax.sharding.PartitionSpec(None, mesh_lib.MODEL_AXIS))
+        partition = (("dense/bias", []), ("dense/kernel", kernel_spec))
+
+        def build(mesh=mesh, b=b):
+            def _build():
+                import numpy as np
+
+                from sparkdl_tpu.parallel.engine import build_dispatch_jit
+
+                variables = {"dense": {
+                    "kernel": jax.ShapeDtypeStruct(
+                        (feature_dim_in, feature_dim_out), np.float32),
+                    "bias": jax.ShapeDtypeStruct((feature_dim_out,),
+                                                 np.float32),
+                }}
+                shardings, _ = mesh_lib.resolve_param_shardings(
+                    variables, mesh)
+                jitted = build_dispatch_jit(wide_dense_fn, mesh,
+                                            donate_batch=False,
+                                            param_shardings=shardings)
+                batch = jax.ShapeDtypeStruct((b, feature_dim_in),
+                                             np.float32)
+                return jitted, (variables, batch)
+
+            return _build
+
+        name = (f"serving/wide_dense/f32/b{b}/"
+                f"dp{axes['data']}tp{axes['model']}")
+        specs.append(ProgramSpec(
+            name=name, kind="dispatch", build=build(), donate=(),
+            donate_reason=WIDE_DENSE_DONATE_REASON,
+            batch_rows=b, mesh_axes=axes,
+            shardings=("params", "batch"),
+            param_partition=partition,
+            group=name))
+    return specs
+
+
+def wide_dense_fn(v, x):
+    """The wide-dense proof model's fn — module-level so the runtime
+    bit-identity test serves the EXACT fn the audited programs lower."""
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ v["dense"]["kernel"] + v["dense"]["bias"])
+
+
+WIDE_DENSE_DONATE_REASON = (
+    "the (b, 128) f32 batch cannot alias the (b, 131072) output — the "
+    "whole point of the wide head is an output wider than its input, "
+    "so XLA would drop the donation")
+
+
 def train_step_specs(batch_rows: int = 32, feature_dim: int = 2048,
                      num_classes: int = 10, mesh=None) -> List[ProgramSpec]:
     """The data-parallel train-step programs the estimator layer
@@ -400,6 +502,11 @@ def stack_programs(max_batch_size: int = 32,
     # ones included): it is model-independent and cheap to lower, and
     # GC001's consumed-donation check is the whole point of it
     specs.extend(generic_dispatch_specs(mesh=mesh))
+    # the tensor-parallel wide-dense programs (ISSUE 14) ride every
+    # audit the same way: cheap to lower, model-independent, and GC005's
+    # sharded-HBM proof (no replicated leaf above budget once the
+    # kernel splits) is the whole point of them
+    specs.extend(sharded_dispatch_specs())
     if include_train:
         # the train batch is the estimator's default fit batch, NOT a
         # serving bucket — keep it fixed so subset audits (--models /

@@ -42,6 +42,19 @@ restarted process B serving the same lockfile-pinned programs reports
 ZERO fresh compiles (``misses == 0``) with bit-identical outputs; a
 tampered manifest fingerprint forces a purge + clean recompile instead
 of ever serving a stale executable.
+
+Sharing contract (ISSUE 14): one cache directory serves ONE
+deployment configuration.  The manifest's ``sharding_policies`` set
+accumulates every engine policy the deployment's processes note
+(restart-order-independent reuse), but a process whose FIRST policy
+the set has never held purges the whole population — so two
+*unrelated* deployments with different sharding policies pointing at
+the same directory would purge each other's executables on every
+cold start.  Give them separate directories.  ``note_policy``'s
+manifest union is atomic per write but not cross-process-locked: two
+processes adding different NEW policies at the same instant can drop
+one addition, which costs at most one later purge + repopulation,
+never a stale executable.
 """
 
 from __future__ import annotations
@@ -141,12 +154,25 @@ def _purge(dir_path: str) -> int:
 
 
 def _validate_manifest(dir_path: str,
-                       lockfile_path: Optional[str]
+                       lockfile_path: Optional[str],
+                       policy: Optional[str] = None
                        ) -> Tuple[Dict[str, Any], List[Tuple[str, dict]]]:
     """Compare the cache directory's manifest against the live
-    committed lockfile; purge + classify on drift.  Returns the state
-    fields and the flight events to emit AFTER the configure lock is
-    released (the recorder never runs under the locks it observes)."""
+    committed lockfile AND the process's mesh/partition-rule policy
+    (ISSUE 14 — ``InferenceEngine.compile_policy()``); purge + classify
+    on drift.  The manifest records the SET of policies the populating
+    deployment's engines used (``sharding_policies`` — every engine
+    notes its policy via :func:`note_policy`, so a fleet mixing
+    sharded and replicated entries reuses across restarts regardless
+    of engine-construction order); a restart whose first policy is NOT
+    in the stored set — same programs, different weight sharding —
+    purges cleanly, classified GC005 (sharding layout changed),
+    instead of serving/accumulating executables compiled for a layout
+    this deployment no longer uses.  ``policy=None`` (test/CLI
+    configures) is a wildcard: it never invalidates a populated set.
+    Returns the state fields and the flight events to emit AFTER the
+    configure lock is released (the recorder never runs under the locks
+    it observes)."""
     import jax
 
     from sparkdl_tpu.analysis.program.lockfile import (DEFAULT_LOCKFILE,
@@ -165,6 +191,7 @@ def _validate_manifest(dir_path: str,
     drift_rules: List[str] = []
     purged = 0
     events: List[Tuple[str, dict]] = []
+    policies: List[str] = [policy] if policy else []
     if os.path.isfile(manifest_path):
         stored: Optional[Dict[str, Any]] = None
         try:
@@ -172,12 +199,18 @@ def _validate_manifest(dir_path: str,
                 stored = json.load(fh)
         except (OSError, json.JSONDecodeError):
             stored = None  # corrupt manifest == unprovable population
+        stored_policies = (list(stored.get("sharding_policies") or [])
+                           if stored is not None else [])
+        policy_ok = policy is None or policy in stored_policies
         if (stored is not None
                 and stored.get("schema_version") == MANIFEST_SCHEMA
                 and stored.get("jax_version") == env["jax_version"]
                 and stored.get("backend") == env["backend"]
+                and policy_ok
                 and _norm(stored.get("programs", {})) == _norm(programs)):
             reused = True
+            policies = sorted(set(stored_policies)
+                              | ({policy} if policy else set()))
         else:
             invalidated = True
             if stored is not None and isinstance(
@@ -187,6 +220,11 @@ def _validate_manifest(dir_path: str,
                 findings = diff_records(
                     {"programs": stored["programs"]}, current)
                 drift_rules = sorted({f.code for f in findings})
+                if not drift_rules and not policy_ok:
+                    # same programs, different weight-sharding policy:
+                    # the executables were compiled for layouts this
+                    # deployment no longer uses
+                    drift_rules = ["GC005"]
             purged = _purge(dir_path)
             events.append(("compile.invalidate", {
                 "dir": dir_path, "purged_entries": purged,
@@ -198,7 +236,8 @@ def _validate_manifest(dir_path: str,
                 (f"lockfile drift classified {drift_rules}"
                  if drift_rules else "unreadable/foreign manifest"),
                 purged)
-    doc = {"schema_version": MANIFEST_SCHEMA, **env, "programs": programs}
+    doc = {"schema_version": MANIFEST_SCHEMA, **env,
+           "sharding_policies": policies, "programs": programs}
     tmp = manifest_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, sort_keys=True)
@@ -207,7 +246,9 @@ def _validate_manifest(dir_path: str,
     os.replace(tmp, manifest_path)
     fields = {"reused": reused, "invalidated": invalidated,
               "drift_rules": drift_rules, "purged_entries": purged,
-              "lockfile_programs": len(programs), **env}
+              "lockfile_programs": len(programs),
+              "sharding_policy": policy,
+              "sharding_policies": policies, **env}
     events.append(("compile.persist", {
         "dir": dir_path, "reused": reused,
         "lockfile_programs": len(programs)}))
@@ -215,7 +256,8 @@ def _validate_manifest(dir_path: str,
 
 
 def _configure_locked(dir_path: Optional[str],
-                      lockfile_path: Optional[str]
+                      lockfile_path: Optional[str],
+                      policy: Optional[str] = None
                       ) -> Tuple[Optional[Dict[str, Any]],
                                  List[Tuple[str, dict]]]:
     """Resolve the cache state (called under the configure lock);
@@ -229,7 +271,7 @@ def _configure_locked(dir_path: Optional[str],
         # fresh compiles), never propagate into engine construction
         inject("compile.cache")
         os.makedirs(dir_path, exist_ok=True)
-        fields, events = _validate_manifest(dir_path, lockfile_path)
+        fields, events = _validate_manifest(dir_path, lockfile_path, policy)
         import jax
 
         jax.config.update("jax_enable_compilation_cache", True)
@@ -250,14 +292,17 @@ def _configure_locked(dir_path: Optional[str],
 
 
 def configure(dir_path: Optional[str],
-              lockfile_path: Optional[str] = None
-              ) -> Optional[Dict[str, Any]]:
+              lockfile_path: Optional[str] = None,
+              policy: Optional[str] = None) -> Optional[Dict[str, Any]]:
     """Install (or disable, with ``None``) the persistent compile cache
     at ``dir_path``, validating its manifest against ``lockfile_path``
-    (default: the committed ``PROGRAMS.lock.json``)."""
+    (default: the committed ``PROGRAMS.lock.json``) and the process's
+    mesh/partition-rule ``policy`` (ISSUE 14; ``None`` = no policy
+    recorded — a later engine-driven configure with a real policy
+    invalidates such a manifest once, classified GC005)."""
     global _state
     with _lock:
-        st, events = _configure_locked(dir_path, lockfile_path)
+        st, events = _configure_locked(dir_path, lockfile_path, policy)
         _state = st
     for name, attrs in events:
         flight_emit(name, **attrs)
@@ -269,22 +314,72 @@ def configure_from_env() -> Optional[Dict[str, Any]]:
     return configure(dir_from_env())
 
 
-def ensure_from_env() -> Optional[Dict[str, Any]]:
+def ensure_from_env(policy: Optional[str] = None
+                    ) -> Optional[Dict[str, Any]]:
     """The per-engine probe: resolve ``SPARKDL_COMPILE_CACHE`` exactly
     once per process (first engine construction), then one
-    module-global read forever after."""
+    module-global read (plus a policy-set membership check) forever
+    after.  Every engine passes its ``compile_policy()`` string: the
+    first one validates the manifest against the stored policy SET,
+    and later engines with NEW policies join the set via
+    :func:`note_policy` — so a deployment mixing sharded and
+    replicated engines reuses across restarts regardless of which
+    engine constructs first, while a policy the deployment never used
+    still purges."""
     global _state
     st = _state
     if st is not _UNSET:
-        return st
+        if policy is not None:
+            note_policy(policy)
+        return _state if isinstance(_state, dict) else None
     with _lock:
-        if _state is not _UNSET:
-            return _state
-        st, events = _configure_locked(dir_from_env(), None)
-        _state = st
+        if _state is _UNSET:
+            st, events = _configure_locked(dir_from_env(), None, policy)
+            _state = st
+        else:
+            st, events = _state, []
     for name, attrs in events:
         flight_emit(name, **attrs)
-    return st
+    if policy is not None:
+        note_policy(policy)
+    return _state if isinstance(_state, dict) else None
+
+
+def note_policy(policy: str) -> None:
+    """Record one engine's mesh/partition policy in the manifest's
+    policy SET (no purge — adding a layout to a live deployment only
+    widens what a restart may reuse).  No-op while disabled or when
+    the policy is already recorded (the per-engine fast path)."""
+    global _state
+    st = _state
+    if (not isinstance(st, dict)
+            or policy in st.get("sharding_policies", [])):
+        return
+    with _lock:
+        st = _state
+        if (not isinstance(st, dict)
+                or policy in st.get("sharding_policies", [])):
+            return
+        manifest_path = os.path.join(st["dir"], MANIFEST_NAME)
+        try:
+            with open(manifest_path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            policies = sorted(set(doc.get("sharding_policies") or [])
+                              | {policy})
+            doc["sharding_policies"] = policies
+            tmp = manifest_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, manifest_path)
+            _state = dict(st, sharding_policies=policies)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning(
+                "compile cache: could not record sharding policy in "
+                "manifest (%s: %s); a restart constructing this "
+                "layout's engine first will purge once",
+                type(e).__name__, e)
 
 
 def state() -> Optional[Dict[str, Any]]:

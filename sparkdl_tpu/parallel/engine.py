@@ -2,10 +2,12 @@
 
 Reference hot loop (SURVEY.md §3.1/§3.2): per-partition TensorFrames
 ``Session::Run`` on each executor, model GraphDef torrent-broadcast to JVMs.
-Here instead: ONE jit-compiled XLA program per (model, batch-shape), params
-resident on device (replicated via NamedSharding — the broadcast analog),
-batch rows sharded over the mesh's data axis, and a fixed padded batch shape
-so XLA never recompiles (SURVEY.md §7 hard part #4).
+Here instead: ONE jit-compiled XLA program per (model, batch-shape,
+sharding policy), params resident on device (replicated via NamedSharding
+— the broadcast analog — or tensor-parallel-sharded across the mesh's
+``model`` axis via partition rules, ISSUE 14), batch rows sharded over
+the mesh's data axis, and a fixed padded batch shape so XLA never
+recompiles (SURVEY.md §7 hard part #4).
 
 Throughput design:
   * fixed ``device_batch_size`` (rounded up to a multiple of the data-axis
@@ -255,8 +257,13 @@ def effective_device_batch(device_batch_size: int, mesh) -> int:
     return b + (dp - rem) if rem else b
 
 
-def build_dispatch_jit(fn: Callable, mesh, donate_batch: bool):
-    """THE per-batch dispatch program: ``jit(fn)`` with params replicated,
+def build_dispatch_jit(fn: Callable, mesh, donate_batch: bool,
+                       param_shardings=None):
+    """THE per-batch dispatch program: ``jit(fn)`` with params placed
+    under ``param_shardings`` (a pytree of per-leaf ``NamedSharding`` —
+    the tensor-parallel weight layout from ``mesh.
+    resolve_param_shardings``; ``None`` = the classic replicate-
+    everything layout, byte-identical to the pre-ISSUE-14 program),
     batch sharded on the data axis, and the batch donated when asked.
     :class:`InferenceEngine` compiles through this (via the module jit
     cache) and ``analysis.program`` lowers the same object abstractly —
@@ -264,19 +271,22 @@ def build_dispatch_jit(fn: Callable, mesh, donate_batch: bool):
     one."""
     import jax
 
+    params_sh = (param_shardings if param_shardings is not None
+                 else mesh_lib.replicated_sharding(mesh))
     return jax.jit(
         fn,
-        in_shardings=(mesh_lib.replicated_sharding(mesh),
-                      mesh_lib.batch_sharding(mesh)),
+        in_shardings=(params_sh, mesh_lib.batch_sharding(mesh)),
         out_shardings=mesh_lib.batch_sharding(mesh),
         donate_argnums=(1,) if donate_batch else ())
 
 
 def build_grouped_dispatch_jit(fn: Callable, mesh, donate_batch: bool,
-                               batches_per_dispatch: int):
+                               batches_per_dispatch: int,
+                               param_shardings=None):
     """The grouped (``batches_per_dispatch`` > 1) dispatch program: one
     ``lax.map`` launch over a stacked leading group axis.  Shared with
-    ``analysis.program`` exactly like :func:`build_dispatch_jit`."""
+    ``analysis.program`` exactly like :func:`build_dispatch_jit`;
+    ``param_shardings`` has the same semantics."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -285,9 +295,11 @@ def build_grouped_dispatch_jit(fn: Callable, mesh, donate_batch: bool,
     def fn_group(v, xs):
         return jax.lax.map(lambda x: fn(v, x), xs)
 
+    params_sh = (param_shardings if param_shardings is not None
+                 else mesh_lib.replicated_sharding(mesh))
     return jax.jit(
         fn_group,
-        in_shardings=(mesh_lib.replicated_sharding(mesh), group_sh),
+        in_shardings=(params_sh, group_sh),
         out_shardings=group_sh,
         donate_argnums=(1,) if donate_batch else ())
 
@@ -338,6 +350,20 @@ class InferenceEngine:
 
     ``fn`` must be jit-traceable with a leading batch axis on ``batch`` and
     on every output leaf (outputs may be a single array or a pytree).
+
+    Weight sharding (ISSUE 14): ``partition_rules`` (a ``(regex,
+    PartitionSpec)`` rule list or a ``mesh -> rules`` factory — see
+    ``mesh.match_partition_rules`` / ``mesh.default_partition_rules``)
+    or an explicit ``param_shardings`` pytree split chosen param leaves
+    across the mesh's ``model`` axis, ending the one-full-weight-copy-
+    per-chip model: each chip holds ``bytes / model_axis`` of a sharded
+    leaf and XLA's SPMD partitioner inserts the collectives the layout
+    implies.  The default (both ``None``) — and any policy that
+    resolves all-replicated, e.g. the default rules on a model-axis-1
+    mesh — keeps the classic replicate-everything layout with
+    byte-identical programs.  The policy is part of the jit-cache key
+    (``sharding_digest``), so engines under different layouts never
+    alias a compiled program.
     """
 
     def __init__(self, fn: Callable, variables: Any, *,
@@ -346,6 +372,8 @@ class InferenceEngine:
                  compute_dtype: Optional[Any] = None,
                  output_host_dtype: Optional[Any] = None,
                  donate_batch: bool = False,
+                 partition_rules: Any = None,
+                 param_shardings: Any = None,
                  batches_per_dispatch: int = 1,
                  dispatch_retries: int = 0,
                  dispatch_backoff_s: float = 0.05,
@@ -358,21 +386,13 @@ class InferenceEngine:
                  metrics: Optional[Metrics] = None):
         import jax
 
-        # Persistent compile cache (ISSUE 13): resolve the
-        # SPARKDL_COMPILE_CACHE knob once per process BEFORE any
-        # program of this engine compiles, so fleet deploys and
-        # serving cold-starts across restarts reuse on-disk
-        # executables keyed on the committed lockfile.  Disabled path
-        # = one module-global read.
-        from sparkdl_tpu.parallel import compile_cache
-
-        compile_cache.ensure_from_env()
         # Scoring is per-controller by design (PERF.md topology
         # envelope): each host scores its own rows on its own devices —
         # see resolve_engine_mesh (the zoo transformers pass no mesh, so
         # the local-devices default keeps them working on pods).
         self.mesh = resolve_engine_mesh(mesh)
         self.data_parallel = self.mesh.shape[mesh_lib.DATA_AXIS]
+        self.model_parallel = self.mesh.shape[mesh_lib.MODEL_AXIS]
         # Round the device batch up to a multiple of the data-axis size so
         # every chip gets identical work.
         b = effective_device_batch(device_batch_size, self.mesh)
@@ -421,15 +441,78 @@ class InferenceEngine:
             variables = _cast_floating(variables, compute_dtype)
         self._replicated = mesh_lib.replicated_sharding(self.mesh)
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
-        # Params live on device once — the NamedSharding replicate is the TPU
-        # analog of the reference's model-GraphDef broadcast.
-        self.variables = jax.device_put(variables, self._replicated)
+        # Tensor-parallel weight sharding (ISSUE 14): resolve the policy
+        # to per-leaf NamedShardings.  ``param_shardings`` (a pytree of
+        # PartitionSpec/NamedSharding matching ``variables``) wins over
+        # ``partition_rules`` (a regex rule list, or a ``mesh -> rules``
+        # factory like mesh.default_partition_rules).  An all-replicated
+        # resolution COLLAPSES to the classic single replicate sharding,
+        # so model-axis-1 meshes build byte-identical programs with the
+        # same executable cache keys as the pre-ISSUE-14 stack.
+        self.param_shardings = None
+        self._param_specs = None
+        if param_shardings is not None:
+            # explicit leaves (PartitionSpec or NamedSharding) are
+            # normalized onto THIS engine's mesh through the ONE
+            # resolution path the rules share — same structure check,
+            # same per-leaf divisibility fallback (an indivisible
+            # explicit spec replicates instead of crashing device_put)
+            self.param_shardings, self._param_specs = (
+                mesh_lib.resolve_param_shardings(variables, self.mesh,
+                                                 specs=param_shardings))
+        elif partition_rules is not None:
+            self.param_shardings, self._param_specs = (
+                mesh_lib.resolve_param_shardings(variables, self.mesh,
+                                                 partition_rules))
+        if (self._param_specs is not None
+                and mesh_lib.specs_all_replicated(self._param_specs)):
+            self.param_shardings = None
+            self._param_specs = None
+        self.sharding_digest = mesh_lib.partition_digest(self._param_specs)
+        # HBM accounting (ISSUE 14 bench rider): per-chip param bytes
+        # under this layout vs the one-full-copy-per-chip baseline,
+        # gauged so bench lines / varz can stamp the claim chip-free
+        self._sharding_stats = mesh_lib.param_sharding_stats(
+            self.mesh, variables, self._param_specs)
+        self.metrics.gauge("engine.mesh_data_axis",
+                           float(self.data_parallel))
+        self.metrics.gauge("engine.mesh_model_axis",
+                           float(self.model_parallel))
+        self.metrics.gauge("engine.replicated_param_bytes",
+                           float(self._sharding_stats["param_bytes_total"]))
+        self.metrics.gauge("engine.param_bytes_per_chip",
+                           float(self._sharding_stats["param_bytes_per_chip"]))
+        # Persistent compile cache (ISSUE 13): resolve the
+        # SPARKDL_COMPILE_CACHE knob once per process BEFORE any
+        # program of this engine compiles, so fleet deploys and
+        # serving cold-starts across restarts reuse on-disk
+        # executables keyed on the committed lockfile.  Disabled path
+        # = one module-global read.  The FIRST engine's mesh/partition
+        # policy keys the manifest (ISSUE 14): a restarted process
+        # under a different sharding policy purges the population
+        # cleanly instead of trusting content-addressing alone.
+        from sparkdl_tpu.parallel import compile_cache
+
+        compile_cache.ensure_from_env(policy=self.compile_policy())
+        # Params live on device once — per-leaf NamedShardings when the
+        # policy splits them (each chip holds bytes/model_axis of a
+        # sharded leaf), the NamedSharding replicate otherwise (the TPU
+        # analog of the reference's model-GraphDef broadcast).
+        self.variables = jax.device_put(
+            variables, self.param_shardings if self.param_shardings
+            is not None else self._replicated)
+        # grid SHAPE is part of the key (as in train._mesh_key): a
+        # (1, 8) and a (2, 4) mesh over the same 8 devices share flat
+        # device ids and axis names but compile different programs
         mesh_key = (tuple(d.id for d in self.mesh.devices.flat),
-                    tuple(self.mesh.axis_names), bool(donate_batch))
+                    tuple(self.mesh.axis_names),
+                    tuple(self.mesh.devices.shape), bool(donate_batch),
+                    self.sharding_digest)
         key = (id(fn),) + mesh_key + (1,)
         compiled = _JIT_CACHE.get(key)
         if compiled is None:
-            compiled = build_dispatch_jit(fn, self.mesh, donate_batch)
+            compiled = build_dispatch_jit(fn, self.mesh, donate_batch,
+                                          param_shardings=self.param_shardings)
             _JIT_CACHE.put(key, compiled)
         # the plain per-batch program always exists: it runs run_padded
         # and the ragged tail group (cheaper than padding a group with
@@ -440,7 +523,8 @@ class InferenceEngine:
             grouped = _JIT_CACHE.get(gkey)
             if grouped is None:
                 grouped = build_grouped_dispatch_jit(
-                    fn, self.mesh, donate_batch, self.batches_per_dispatch)
+                    fn, self.mesh, donate_batch, self.batches_per_dispatch,
+                    param_shardings=self.param_shardings)
                 _JIT_CACHE.put(gkey, grouped)
             self._compiled_group = grouped
 
@@ -561,6 +645,24 @@ class InferenceEngine:
     def breaker_state(self) -> Dict[str, Any]:
         """The dispatch circuit breaker's JSON-serializable snapshot."""
         return self.breaker.state()
+
+    def compile_policy(self) -> str:
+        """The mesh + partition-rule policy string keying the persistent
+        compile-cache manifest (``parallel.compile_cache``): a restarted
+        process whose first engine resolves a DIFFERENT policy purges
+        the on-disk executable population instead of trusting
+        content-addressing alone."""
+        return (f"mesh={self.data_parallel}x{self.model_parallel}"
+                f"|params={self.sharding_digest}")
+
+    def sharding_info(self) -> Dict[str, Any]:
+        """JSON snapshot of this engine's weight-sharding layout (ISSUE
+        14): mesh shape, total vs per-chip param bytes, sharded leaf
+        count, and the policy digest — what ``Server.varz`` embeds and
+        the bench HBM rider stamps next to ``pad_overhead``."""
+        return dict(self._sharding_stats,
+                    sharding_digest=self.sharding_digest,
+                    sharded=self.param_shardings is not None)
 
     def run_padded(self, batch):
         """Run one already-padded device batch (array or pytree of arrays

@@ -235,9 +235,13 @@ def resolve_param_specs(param_specs, params, mesh):
     from jax.sharding import NamedSharding, PartitionSpec
 
     if callable(param_specs):
+        # the ONE leaf-name spelling (mesh.param_path_str), shared with
+        # the inference engine's partition rules and the program
+        # auditor — a rule written against one surface matches the
+        # same names everywhere (sequence-indexed pytrees included)
         def rule(path, leaf):
-            name = "/".join(str(getattr(k, "key", k)) for k in path)
-            return NamedSharding(mesh, param_specs(name, leaf))
+            return NamedSharding(
+                mesh, param_specs(mesh_lib.param_path_str(path), leaf))
 
         flat = jax.tree_util.tree_flatten_with_path(params)
         return jax.tree_util.tree_unflatten(

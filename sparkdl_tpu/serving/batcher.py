@@ -105,6 +105,7 @@ class DynamicBatcher:
                  max_wait_ms: float = 5.0,
                  max_queue: int = 1024,
                  bucket_plan: Optional[Sequence[int]] = None,
+                 align: int = 1,
                  metrics: Optional[Metrics] = None):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got "
@@ -112,6 +113,19 @@ class DynamicBatcher:
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_batch_size = int(max_batch_size)
+        # Mesh alignment (ISSUE 14): ``align`` is the serving mesh's
+        # data-axis size.  Every ragged CUT must land on a compiled
+        # bucket boundary that is a multiple of it (the engine rounds
+        # its device batch the same way — effective_device_batch), so a
+        # raw bucket plan is rounded up here exactly as the engine
+        # would round it; the Server already passes mesh-rounded
+        # buckets, making this a no-op there, but a batcher constructed
+        # directly with raw buckets must not cut at sizes the mesh
+        # cannot split evenly.  A bucket rounded ABOVE max_batch_size
+        # is reachable only via top-off, exactly like a Server whose
+        # mesh-rounded bucket exceeds its configured batch (_ragged_take
+        # keeps the baseline's max_batch_size cut contract).
+        self.align = max(1, int(align))
         # Ragged mode (ISSUE 13): with the server's compiled bucket plan
         # in hand, flushes cut the queue at bucket boundaries (module
         # docstring).  None = the flush-on-full baseline.
@@ -120,6 +134,10 @@ class DynamicBatcher:
             if not bucket_plan or bucket_plan[0] < 1:
                 raise ValueError(f"bucket_plan must be positive, got "
                                  f"{bucket_plan}")
+            if self.align > 1:
+                bucket_plan = sorted(
+                    {b + (self.align - b % self.align) % self.align
+                     for b in bucket_plan})
         self.bucket_plan = bucket_plan
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.max_queue = int(max_queue)

@@ -145,7 +145,12 @@ class Fleet:
                   **server_kwargs) -> ModelVersion:
         """Register entry ``name`` (v1) and deploy it immediately.
         ``server_kwargs`` become this entry's Server configuration (on
-        top of the fleet defaults) for v1 and every later version."""
+        top of the fleet defaults) for v1 and every later version —
+        including the tensor-parallel weight-sharding knob (ISSUE 14):
+        ``partition_rules=``/``param_shardings=`` shard the entry's
+        weights across the serving mesh's ``model`` axis on every
+        version's server (zoo entries default to
+        ``mesh.default_partition_rules`` via their serving bundle)."""
         with self._lock:
             if self._closed:
                 raise ServerClosedError("fleet is closed")
@@ -198,12 +203,24 @@ class Fleet:
 
     def _build_server(self, entry, mv: ModelVersion,
                       server_kwargs: Dict[str, Any]) -> Server:
+        # precedence, most specific wins: explicit per-entry
+        # server_kwargs > the entry's resolved bundle overrides > the
+        # fleet-wide _server_defaults.  The bundle's DTYPE contract
+        # (e.g. zoo bf16 compute + f32 host cast) additionally yields
+        # whenever the caller set either dtype knob anywhere; its
+        # OTHER overrides (partition_rules, donate_batch — the
+        # recorded GC001 exemption) must beat fleet-wide defaults
+        # regardless of the dtype choice.
+        dtype_keys = ("compute_dtype", "output_host_dtype")
+        caller_set_dtype = any(k in server_kwargs
+                               or k in self._server_defaults
+                               for k in dtype_keys)
         kw = dict(self._server_defaults)
+        for k, v in entry.engine_overrides.items():
+            if k in dtype_keys and caller_set_dtype:
+                continue
+            kw[k] = v
         kw.update(server_kwargs)
-        # the entry's resolved dtype contract (e.g. the zoo bf16 compute
-        # + f32 host cast) applies unless the caller set the knobs
-        if ("compute_dtype" not in kw and "output_host_dtype" not in kw):
-            kw.update(entry.engine_overrides)
         if "cache" not in kw:
             if self._cache is not None:
                 fp = self._resolve_fingerprint(entry)

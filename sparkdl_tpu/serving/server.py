@@ -268,6 +268,16 @@ class Server:
         donation is CONSUMED — some output leaf aliases the batch;
         zoo models resolve to False by recorded GC001 exemption, their
         uint8 batch can never alias the float features).
+      * ``partition_rules`` / ``param_shardings`` — tensor-parallel
+        WEIGHT sharding (ISSUE 14): a ``(regex, PartitionSpec)`` rule
+        list (or ``mesh -> rules`` factory) / an explicit per-leaf spec
+        pytree splitting chosen params across the mesh's ``model``
+        axis, so every bucket engine holds ``bytes / model_axis`` of a
+        sharded leaf instead of one full weight copy per chip.  Zoo
+        models default to ``mesh.default_partition_rules`` (resolves
+        all-replicated — byte-identical programs — unless the mesh has
+        a model axis > 1); ``varz()["sharding"]`` reports the resolved
+        layout and per-chip HBM bytes.
     """
 
     def __init__(self, model, variables: Any = None, *,
@@ -293,6 +303,8 @@ class Server:
                  cache_namespace: Optional[Sequence[Any]] = None,
                  ragged: Optional[bool] = None,
                  donate_batch: Optional[bool] = None,
+                 partition_rules: Any = None,
+                 param_shardings: Any = None,
                  metrics: Optional[Metrics] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
@@ -305,12 +317,29 @@ class Server:
             # else stays None = probe per bucket at first dispatch
             donate_batch = _overrides.get("donate_batch")
         self._donate_batch = donate_batch
+        # Tensor-parallel weight sharding (ISSUE 14): the policy every
+        # bucket engine compiles/places weights under.  Zoo models
+        # default to the per-family rules (mesh.default_partition_rules
+        # via zoo_serving_bundle overrides) — a no-op replicate on
+        # model-axis-1 meshes, weight splitting when the mesh has a
+        # usable model axis; explicit partition_rules/param_shardings
+        # always win.
+        if partition_rules is None and param_shardings is None:
+            partition_rules = _overrides.get("partition_rules")
+        self._partition_rules = partition_rules
+        self._param_shardings = param_shardings
         self.metrics = metrics if metrics is not None else Metrics()
         self.max_batch_size = max(1, int(max_batch_size))
+        from sparkdl_tpu.parallel import mesh as mesh_lib
+        from sparkdl_tpu.parallel.engine import resolve_engine_mesh
+
+        resolved_mesh = resolve_engine_mesh(mesh)
+        self._data_parallel = int(resolved_mesh.shape[mesh_lib.DATA_AXIS])
         # mesh-rounded, de-duplicated compiled shapes; also what the
         # program auditor enumerates (bucket_plan docstring)
         self._buckets = bucket_plan(self.max_batch_size,
-                                    bucket_sizes=bucket_sizes, mesh=mesh)
+                                    bucket_sizes=bucket_sizes,
+                                    mesh=resolved_mesh)
         self._default_timeout_s = (None if default_timeout_ms is None
                                    else max(0.0, default_timeout_ms) / 1e3)
         self._dispatch_timeout_s = (None if dispatch_timeout_ms is None
@@ -373,6 +402,7 @@ class Server:
             max_batch_size=self.max_batch_size, max_wait_ms=max_wait_ms,
             max_queue=max_queue,
             bucket_plan=self._buckets if self._ragged else None,
+            align=self._data_parallel,
             metrics=self.metrics)
         # Slow-request exemplars: top-K span trees, surfaced by varz();
         # inert (offer() returns False) unless SPARKDL_TRACE is on.
@@ -470,6 +500,12 @@ class Server:
                                    else self._compute_dtype),
                     output_host_dtype=self._output_host_dtype,
                     donate_batch=bool(donate),
+                    # later buckets resolve the same policy against the
+                    # first bucket's already-sharded device arrays —
+                    # same specs, so device_put is a per-leaf no-op and
+                    # every bucket shares one device copy of the weights
+                    partition_rules=self._partition_rules,
+                    param_shardings=self._param_shardings,
                     dispatch_retries=self._dispatch_retries,
                     breaker_threshold=self._breaker_threshold,
                     breaker_cooldown_s=self._breaker_cooldown_s,
@@ -1000,6 +1036,17 @@ class Server:
         """The key prefix this server's entries live under."""
         return self._cache_ns
 
+    def sharding_info(self) -> Optional[Dict[str, Any]]:
+        """The bucket engines' weight-sharding layout (ISSUE 14):
+        mesh shape, total vs per-chip param bytes, sharded leaf count,
+        policy digest.  All buckets share one device weight copy and
+        one policy, so the first engine's snapshot speaks for the
+        server; ``None`` until a bucket engine exists (pre-warmup, no
+        traffic yet)."""
+        with self._engine_lock:
+            first = next(iter(self._engines.values()), None)
+        return None if first is None else first.sharding_info()
+
     def executable_state(self) -> Dict[int, Dict[str, Any]]:
         """Per-bucket compiled-program identity: the ``id()`` of the
         bucket engine's shared ``jax.jit`` object and that object's
@@ -1069,6 +1116,7 @@ class Server:
             "metrics": snap,
             "cache": (self._cache.info() if self._cache is not None
                       else None),
+            "sharding": self.sharding_info(),
             "exemplars": self.exemplars.snapshot(),
         }
 

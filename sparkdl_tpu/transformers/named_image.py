@@ -115,7 +115,18 @@ def zoo_serving_bundle(name: str, featurize: bool):
     # output, so declaring the donation would only make XLA drop it —
     # the serving auto-donation probe must not even try
     # (analysis.program.inventory.ZOO_DONATE_REASON).
-    overrides: Dict[str, object] = {"donate_batch": False}
+    # Weight sharding (ISSUE 14): the zoo family's default partition
+    # rules ride the overrides — flax kernels split their output dim
+    # across the mesh's model axis when it is >1 (per-chip HBM =
+    # bytes/model_axis), and resolve all-replicated (byte-identical
+    # programs) on the model-axis-1 meshes every current zoo config
+    # uses.  An explicit Server partition_rules/param_shardings wins.
+    from sparkdl_tpu.parallel import mesh as _mesh_lib
+
+    overrides: Dict[str, object] = {
+        "donate_batch": False,
+        "partition_rules": _mesh_lib.default_partition_rules,
+    }
     if zoo_compute_dtype_name() == "bfloat16":
         import jax.numpy as jnp
 

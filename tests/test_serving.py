@@ -478,8 +478,13 @@ def test_named_model_honors_zoo_compute_dtype(monkeypatch):
     monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "float32")
     _, _, ov = server_mod._resolve_model("FakeZoo", None, True)
     # zoo overrides always pin donation OFF (the recorded GC001
-    # exemption: a uint8 batch can never alias the float features)
-    assert ov == {"donate_batch": False}
+    # exemption: a uint8 batch can never alias the float features) and
+    # carry the family's default partition rules (ISSUE 14 — an
+    # all-replicated no-op until the mesh grows a model axis)
+    from sparkdl_tpu.parallel import mesh as mesh_lib
+
+    assert ov == {"donate_batch": False,
+                  "partition_rules": mesh_lib.default_partition_rules}
     monkeypatch.setenv("SPARKDL_ZOO_COMPUTE_DTYPE", "bogus")
     with pytest.raises(ValueError, match="not supported"):
         server_mod._resolve_model("FakeZoo", None, True)
