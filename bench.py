@@ -1414,6 +1414,74 @@ def bench_ragged():
          })
 
 
+_TWIN_BENCH = r"""
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.twin import (DEFAULT_TENANT_QUOTA, QuotaAutoscaler,
+                              ScenarioConfig, run_day)
+cfg = ScenarioConfig()  # the canonical 288-tick, 64-tenant seeded day
+t0 = time.perf_counter()
+res = run_day(cfg, policy=QuotaAutoscaler(DEFAULT_TENANT_QUOTA))
+wall_s = time.perf_counter() - t0
+s = res.scores
+print(json.dumps({
+    "wall_s": round(wall_s, 3),
+    "virtual_day_s": cfg.ticks * cfg.tick_s,
+    "offered": s["offered"],
+    "submitted": s["submitted"],
+    "shed": s["shed"],
+    "tenants_active": s["tenants_active"],
+    "slo_minutes": s["slo_minutes"],
+    "breach_ticks": s["breach_ticks"],
+    "goodput": s["goodput"],
+    "fairness": s["fairness"],
+    "cache_hit_rate": s["cache_hit_rate"],
+    "stream_commits": s["stream_commits"],
+    "event_digest": res.event_digest,
+    "requests_per_wall_s": round(s["offered"] / wall_s, 1),
+}))
+"""
+
+
+def bench_twin():
+    """Traffic-twin day replay (ISSUE 16): the canonical seeded day
+    (~160k virtual requests, 64 tenants, flash crowd + retry storm)
+    driven through a REAL fleet on virtual time with the adaptive
+    policy in the loop.  Headline is simulated-requests/sec of wall
+    time — the 'replay a day in tier-1 seconds' compression ratio —
+    with the day's SLO-minutes/goodput/fairness/cache-hit scorecard
+    and the byte-stable event digest stamped alongside."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_TWIN_BENCH, timeout_s=480, env=env)
+    emit("twin",
+         "traffic-twin canonical day replay throughput (virtual-time "
+         "fleet, adaptive policy in the loop)",
+         prof["requests_per_wall_s"], "simulated requests/sec",
+         env_bound="synthetic: virtual-clock fleet on host CPU "
+                   "(measures the twin/control-loop layer, not the "
+                   "chip)",
+         extra={
+             "wall_s": prof["wall_s"],
+             "virtual_day_s": prof["virtual_day_s"],
+             "offered": prof["offered"],
+             "submitted": prof["submitted"],
+             "shed": prof["shed"],
+             "tenants_active": prof["tenants_active"],
+             "slo_minutes": prof["slo_minutes"],
+             "breach_ticks": prof["breach_ticks"],
+             "goodput": prof["goodput"],
+             "fairness": prof["fairness"],
+             "cache_hit_rate": prof["cache_hit_rate"],
+             "stream_commits": prof["stream_commits"],
+             "event_digest": prof["event_digest"],
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -1427,17 +1495,19 @@ BENCHES = {
     "streaming": bench_streaming,
     "cache": bench_cache,
     "ragged": bench_ragged,
+    "twin": bench_twin,
 }
 
 
 # Configs that never need the chip: "serving" and "fleet" run on their
 # CPU fallback (they measure the serving/fleet envelopes —
 # queue/batching/admission/swap/dispatch), "pipeline", "cache", and
-# "ragged" simulate their device with a deterministic sleep, and
-# "streaming" measures the journal'd crash-resume path on synthetic
-# in-memory chunks.
+# "ragged" simulate their device with a deterministic sleep, "streaming"
+# measures the journal'd crash-resume path on synthetic in-memory
+# chunks, and "twin" replays a whole virtual-clock day through a real
+# fleet on the CPU backend.
 _CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming", "cache",
-                     "ragged")
+                     "ragged", "twin")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -1486,7 +1556,7 @@ def main():
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
     default = ("1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming,cache,"
-               "ragged")
+               "ragged,twin")
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

@@ -84,6 +84,12 @@ if [[ ! -f tests/test_graftcheck.py ]]; then
        "ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_twin.py ]]; then
+  echo "FATAL: tests/test_twin.py missing — the traffic-twin subsystem" \
+       "(virtual-time determinism, closed-loop policy/placement) would" \
+       "ship untested" >&2
+  exit 1
+fi
 
 # graftlint stage (ISSUE 5): the repo's own invariants (joined threads,
 # lockset discipline, registered fault sites, paired spans, monotonic
@@ -641,3 +647,67 @@ echo "== graftlint obs package self-check =="
 timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/obs \
   --sites-file sparkdl_tpu/faults/sites.py \
   --events-file sparkdl_tpu/obs/flight.py
+
+# Traffic-twin stage (ISSUE 16): the virtual-time load simulator and
+# its closed control loops re-proven under chaos and a speed guard.
+#   (a) the twin suite re-runs with SPARKDL_FAULTS carrying real twin.*
+#       rules (the tests install their own plans over it, but the env
+#       gate itself is then exercised: the bounded twin.tick sleep must
+#       stretch only WALL time — byte determinism is asserted inside
+#       the suite) and SPARKDL_LOCKCHECK=1 so the twin.clock lock feeds
+#       the lock-order graph nested inside the serving locks;
+#   (b) a scoped graftlint self-check over the new package;
+#   (c) the speed guard: the canonical seeded day (>=100k virtual
+#       requests across >=50 tenants against a REAL fleet) must run
+#       TWICE, byte-identical, inside a pinned wall budget — the
+#       "tier-1 seconds for a simulated day" acceptance bar.  Measured
+#       ~13 s/run on an idle host; 120 s per run is the loaded-CI
+#       ceiling before this counts as a performance regression.
+echo "== traffic-twin suite (SPARKDL_FAULTS active) =="
+SPARKDL_FAULTS="seed=7;twin.tick:sleep:ms=1,times=2" \
+  SPARKDL_LOCKCHECK=1 \
+  timeout -k 10 300 python -m pytest tests/test_twin.py -q -m 'not slow'
+echo "== graftlint twin package self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/twin \
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py
+echo "== traffic-twin speed guard (canonical day, twice) =="
+env -u SPARKDL_FAULTS timeout -k 10 300 python - <<'PY'
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu import faults
+from sparkdl_tpu.twin import (DEFAULT_TENANT_QUOTA, QuotaAutoscaler,
+                              ScenarioConfig, run_day)
+
+faults.clear()
+BUDGET_S = 120.0
+cfg = ScenarioConfig()  # the canonical 288-tick, 64-tenant day
+walls = []
+results = []
+for _ in range(2):
+    t0 = time.perf_counter()
+    results.append(run_day(cfg, policy=QuotaAutoscaler(
+        DEFAULT_TENANT_QUOTA)))
+    walls.append(time.perf_counter() - t0)
+r1, r2 = results
+print(json.dumps({"wall_s": [round(w, 2) for w in walls],
+                  "offered": r1.scores["offered"],
+                  "tenants": r1.scores["tenants_active"],
+                  "slo_minutes": r1.scores["slo_minutes"],
+                  "goodput": r1.scores["goodput"],
+                  "digest": r1.event_digest[:16]}))
+assert r1.scores["offered"] >= 100_000, r1.scores
+assert r1.scores["tenants_active"] >= 50, r1.scores
+assert r1.event_digest == r2.event_digest, (
+    "two runs of the canonical seeded day diverged — the twin's "
+    "determinism contract is broken")
+assert r1.scores == r2.scores
+assert max(walls) <= BUDGET_S, (
+    f"canonical day took {max(walls):.1f}s (budget {BUDGET_S:.0f}s) — "
+    f"a simulated day no longer fits tier-1-compatible wall time")
+print("traffic-twin speed guard ok")
+PY

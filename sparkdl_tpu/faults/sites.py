@@ -57,6 +57,14 @@ SITE_HELP = {
                       "journal commit — the exactly-once crash window"),
     "stream.resume": ("journal replay of an uncommitted chunk at "
                       "restart (redelivery-time failure)"),
+    "twin.tick": ("traffic-twin virtual tick boundary — a sleep rule "
+                  "stretches wall time without moving virtual time "
+                  "(the determinism contract must hold); an error rule "
+                  "is a control-plane crash mid-day"),
+    "twin.arrival": ("traffic-twin per-arrival submit into the real "
+                     "fleet — a transient error rule drops that "
+                     "arrival at the door (scored as a shed, the "
+                     "scenario replay stays deterministic)"),
     "probe.device": "__graft_entry__ device-count relay probe",
     "bench.relay_probe": "bench.py relay profile probe",
     "io.decode": "host image decode, per row",

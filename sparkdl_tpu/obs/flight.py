@@ -123,6 +123,15 @@ EVENT_HELP = {
     "stream.redelivery": ("restart replayed a chunk a previous run left "
                           "uncommitted"),
     "stream.commit": "a stream chunk's journal commit reached disk",
+    "twin.scenario": ("the traffic twin entered a scenario phase "
+                      "(flash crowd, retry storm, canary start — attrs "
+                      "carry the virtual time and phase)"),
+    "policy.adjust": ("the twin policy engine changed a control knob "
+                      "(tenant quota, deadline, canary fraction — "
+                      "attrs carry the lever and new value)"),
+    "placement.plan": ("the HBM-aware placement planner produced a "
+                       "fleet-to-mesh-slice plan (attrs carry chips, "
+                       "per-chip bytes and the plan digest)"),
     "fault.fired": "an injected fault rule fired at its site",
     "retry.attempt": "a transient failure is about to be re-executed",
     "slo.breach": "an SLO's burn rate crossed its threshold",

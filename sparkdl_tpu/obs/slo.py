@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.obs.flight import emit as flight_emit
@@ -158,8 +158,12 @@ class SLOEngine:
                  short_window_s: float = 60.0,
                  long_window_s: float = 300.0,
                  max_samples: int = 512,
-                 seed_zero_baseline: bool = False):
+                 seed_zero_baseline: bool = False,
+                 clock: Optional[Callable[[], float]] = None):
         self.metrics = metrics
+        # monotonic source for evaluate()'s implicit ``now`` — injectable
+        # so a virtual-time harness samples burn windows deterministically
+        self._clock = clock if clock is not None else time.monotonic
         self.objectives: List[SLO] = list(objectives)
         for o in self.objectives:
             if not isinstance(o, SLO):
@@ -223,7 +227,7 @@ class SLOEngine:
         "Flight recorder & SLOs").  Transitions feed the health tracker
         and the flight recorder AFTER the engine lock is released."""
         if now is None:
-            now = time.monotonic()
+            now = self._clock()
         raw = self.metrics.snapshot_raw()
         counters = raw["counters"]
         cur = {n: float(counters.get(n, 0.0)) for n in self._counter_names}

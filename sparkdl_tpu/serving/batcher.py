@@ -29,7 +29,7 @@ import os
 import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
-from typing import Any, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from sparkdl_tpu.analysis.lockcheck import named_condition
 from sparkdl_tpu.faults import inject
@@ -70,10 +70,13 @@ class Request:
     __slots__ = ("payload", "future", "enqueued_at", "deadline", "span",
                  "batch_span")
 
-    def __init__(self, payload: Any, deadline: Optional[float] = None):
+    def __init__(self, payload: Any, deadline: Optional[float] = None,
+                 now: Optional[float] = None):
         self.payload = payload
         self.future: Future = Future()
-        self.enqueued_at = time.monotonic()
+        # ``now`` lets a clock-injected caller stamp queue entry on the
+        # same (possibly virtual) timeline its deadlines live on
+        self.enqueued_at = time.monotonic() if now is None else now
         self.deadline = deadline
         self.span = None
         self.batch_span = None
@@ -106,7 +109,8 @@ class DynamicBatcher:
                  max_queue: int = 1024,
                  bucket_plan: Optional[Sequence[int]] = None,
                  align: int = 1,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got "
                              f"{max_batch_size}")
@@ -153,6 +157,12 @@ class DynamicBatcher:
         # Server-maintained estimate of one batch's service time; seeds the
         # retry_after hint before the first batch completes.
         self.batch_seconds_hint = max(self.max_wait_s, 1e-3)
+        # Injected monotonic clock: every flush/age/deadline judgement
+        # reads THIS source, so a virtual clock (the traffic twin's)
+        # drives the whole wait-window state machine deterministically.
+        # Condition WAITS still time out on the real clock — a frozen
+        # virtual clock re-checks flush conditions on submit/:meth:`wake`.
+        self._clock = clock if clock is not None else time.monotonic
         self._q: deque = deque()
         self._cond = named_condition("serving.batcher")
         self._closed = False
@@ -200,6 +210,17 @@ class DynamicBatcher:
         with self._cond:
             return len(self._q)
 
+    def wake(self) -> None:
+        """Nudge the dispatcher to re-evaluate its flush conditions.
+
+        With an injected clock the age/deadline triggers only move when
+        that clock does — and nothing else notifies the condition when
+        it moves.  A virtual-time driver advances its clock, then calls
+        this, so wait-window flushes fire at the virtual instant they
+        would have fired at on the real clock."""
+        with self._cond:
+            self._cond.notify_all()
+
     # -- flush (dispatcher thread) ----------------------------------------
     def next_batch(self) -> Optional[List[Request]]:
         """Block until a micro-batch is due; return its LIVE requests.
@@ -215,12 +236,12 @@ class DynamicBatcher:
         returns None only when closed and fully drained.
         """
         with self._cond:
-            now = time.monotonic()
+            now = self._clock()
             while True:
                 if self._q:
                     if self._closed:
                         break  # draining: flush whatever is left
-                    now = time.monotonic()
+                    now = self._clock()
                     oldest_wait = now - self._q[0].enqueued_at
                     earliest = min(
                         (r.deadline for r in self._q
@@ -239,7 +260,7 @@ class DynamicBatcher:
                     return None
                 else:
                     self._cond.wait()
-                    now = time.monotonic()
+                    now = self._clock()
             take = min(len(self._q), self.max_batch_size)
             if self.bucket_plan is not None:
                 take = self._ragged_take(len(self._q), now)
@@ -345,7 +366,7 @@ class DynamicBatcher:
             if not batch:
                 return []
             self.metrics.gauge("serving.queue_depth", float(len(self._q)))
-            now = time.monotonic()
+            now = self._clock()
         return self._shed_expired(batch, now)
 
     def _shed_expired(self, batch: List[Request],

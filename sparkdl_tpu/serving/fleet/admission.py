@@ -27,15 +27,17 @@ Shed order under pressure (lowest priority first):
   tenant; the fleet releases the slot when the request's future
   settles.
 
-Determinism: the bucket runs on ``time.monotonic`` only and holds no
-RNG, so a fixed submission schedule admits/sheds identically run to
-run (the chaos test's quota-tolerance assertion depends on this).
+Determinism: the bucket runs on an injected monotonic ``clock``
+(``time.monotonic`` by default) and holds no RNG, so a fixed submission
+schedule admits/sheds identically run to run (the chaos test's
+quota-tolerance assertion depends on this), and a virtual clock (the
+traffic twin's) makes the refill schedule itself deterministic.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import time
 
@@ -96,7 +98,8 @@ class AdmissionController:
     def __init__(self, quotas: Optional[Dict[str, TenantQuota]] = None,
                  default_quota: Optional[TenantQuota] = None,
                  shed_pressure: Optional[Dict[int, float]] = None,
-                 retry_after_cap_s: float = 60.0):
+                 retry_after_cap_s: float = 60.0,
+                 clock: Optional[Callable[[], float]] = None):
         self._quotas: Dict[str, TenantQuota] = dict(quotas or {})
         self.default_quota = (default_quota if default_quota is not None
                               else TenantQuota())
@@ -104,6 +107,9 @@ class AdmissionController:
         if shed_pressure:
             self.shed_pressure.update(shed_pressure)
         self.retry_after_cap_s = float(retry_after_cap_s)
+        #: monotonic seconds source for bucket refills — injectable so a
+        #: virtual-time harness can drive admission deterministically
+        self._clock = clock if clock is not None else time.monotonic
         self._lock = named_lock("fleet.admission")
         #: tenant -> [tokens, last_refill_monotonic]
         self._buckets: Dict[str, list] = {}
@@ -174,7 +180,7 @@ class AdmissionController:
             elif q.rate_per_s is not None:
                 rate = float(q.rate_per_s)
                 burst = q.effective_burst()
-                now = time.monotonic()
+                now = self._clock()
                 bucket = self._buckets.get(tenant)
                 if bucket is None:
                     bucket = self._buckets[tenant] = [burst, now]

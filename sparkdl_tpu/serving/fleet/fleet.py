@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from sparkdl_tpu.analysis.lockcheck import named_lock
 from sparkdl_tpu.faults import inject
@@ -84,8 +84,15 @@ class Fleet:
                  cache: Any = None,
                  program_fingerprints: Any = None,
                  metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], float]] = None,
                  **server_defaults):
         self.metrics = metrics if metrics is not None else Metrics()
+        # Injected monotonic clock (ISSUE 16): one source drives the
+        # admission buckets, the fleet SLO engine, latency accounting
+        # AND (via server_defaults) every server this fleet builds — so
+        # a virtual-time harness steps the entire serving stack on one
+        # deterministic timeline.
+        self._clock = clock if clock is not None else time.monotonic
         self.registry = ModelRegistry()
         # ONE result cache for the whole fleet (ISSUE 11), with
         # per-version key namespaces ``(model, version, fingerprint)``
@@ -116,7 +123,7 @@ class Fleet:
         self._version_meta: Dict[Any, Any] = {}
         self.admission = AdmissionController(
             quotas=quotas, default_quota=default_quota,
-            shed_pressure=shed_pressure)
+            shed_pressure=shed_pressure, clock=self._clock)
         # Fleet-level health (ISSUE 9): the per-model servers keep their
         # own trackers; this one carries fleet-wide objectives — an SLO
         # burn-rate breach over the fleet.* series degrades it, and its
@@ -128,8 +135,12 @@ class Fleet:
             from sparkdl_tpu.obs.slo import SLOEngine
 
             self._slo_engine = SLOEngine(self.metrics, slos,
-                                         health=self._health)
+                                         health=self._health,
+                                         clock=self._clock)
         self._server_defaults = dict(server_defaults)
+        if clock is not None:
+            # explicit per-entry server_kwargs may still override
+            self._server_defaults.setdefault("clock", clock)
         self._lock = named_lock("fleet.state")
         self._models: Dict[str, _ModelState] = {}
         self._closed = False
@@ -469,7 +480,7 @@ class Fleet:
             quota = self.admission.admit(
                 tenant, pressure=server.queue_pressure(),
                 unavailable_retry_after=server.breaker_retry_after())
-            t0 = time.monotonic()
+            t0 = self._clock()
             tracer = get_tracer()
             span = tracer.start_span("fleet.request", model=name,
                                      version=version, tenant=tenant,
@@ -511,7 +522,7 @@ class Fleet:
             self.admission.release(tenant)
             failed = f.cancelled() or f.exception() is not None
             self.metrics.record_time("fleet.request_latency",
-                                     time.monotonic() - t0)
+                                     self._clock() - t0)
             if failed:
                 self.metrics.incr("fleet.request_failures")
                 self._count(name, tenant, "failed")
@@ -559,6 +570,19 @@ class Fleet:
         state = self._state(name)
         with self._lock:
             return state.version
+
+    def wake(self) -> None:
+        """Nudge every deployed server's batcher (stable AND canary) to
+        re-evaluate its flush windows — the fleet-wide form of
+        :meth:`Server.wake`, called by a virtual-time driver after it
+        advances the injected clock."""
+        with self._lock:
+            states = list(self._models.values())
+        for state in states:
+            state.server.wake()
+            ro = state.rollout
+            if ro is not None and ro.active:
+                ro.canary_server.wake()
 
     def health(self) -> Dict[str, Any]:
         """Aggregated liveness/readiness, built through the ONE

@@ -305,7 +305,8 @@ class Server:
                  donate_batch: Optional[bool] = None,
                  partition_rules: Any = None,
                  param_shardings: Any = None,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
         if compute_dtype is None and output_host_dtype is None:
@@ -329,6 +330,13 @@ class Server:
         self._partition_rules = partition_rules
         self._param_shardings = param_shardings
         self.metrics = metrics if metrics is not None else Metrics()
+        # Injected monotonic clock (ISSUE 16): deadlines, queue ages and
+        # latency accounting all read THIS source, so a virtual-time
+        # harness (the traffic twin) drives the whole request path
+        # deterministically.  Real-time mechanics stay real: close()'s
+        # drain wait, the dispatch watchdog and the follower deadline
+        # guard are wall-clock liveness devices, not request semantics.
+        self._clock = clock if clock is not None else time.monotonic
         self.max_batch_size = max(1, int(max_batch_size))
         from sparkdl_tpu.parallel import mesh as mesh_lib
         from sparkdl_tpu.parallel.engine import resolve_engine_mesh
@@ -370,7 +378,8 @@ class Server:
             from sparkdl_tpu.obs.slo import SLOEngine
 
             self._slo_engine = SLOEngine(self.metrics, slos,
-                                         health=self._health)
+                                         health=self._health,
+                                         clock=self._clock)
         # Content-addressed result cache + single-flight coalescing
         # (ISSUE 11): probe BEFORE the admission-queue charge — a hit
         # costs zero queue slots and zero dispatches, a coalesced
@@ -403,7 +412,7 @@ class Server:
             max_queue=max_queue,
             bucket_plan=self._buckets if self._ragged else None,
             align=self._data_parallel,
-            metrics=self.metrics)
+            metrics=self.metrics, clock=self._clock)
         # Slow-request exemplars: top-K span trees, surfaced by varz();
         # inert (offer() returns False) unless SPARKDL_TRACE is on.
         self.exemplars = ExemplarReservoir(k=4)
@@ -639,7 +648,7 @@ class Server:
         """The cache-fronted request path; see :meth:`submit`."""
         import jax
 
-        t0 = time.monotonic()
+        t0 = self._clock()
         if self._host_preprocess is not None:
             example = self._host_preprocess(example)
         example = jax.tree_util.tree_map(np.asarray, example)
@@ -650,7 +659,7 @@ class Server:
             self.metrics.incr("serving.completed")
             self.metrics.incr("serving.cache_hits")
             self.metrics.record_time("serving.request_latency",
-                                     time.monotonic() - t0)
+                                     self._clock() - t0)
             fut: Future = Future()
             fut.set_result(res)
             return fut
@@ -662,7 +671,7 @@ class Server:
                 if not f.cancelled() and f.exception() is None:
                     self.metrics.incr("serving.completed")
                     self.metrics.record_time("serving.request_latency",
-                                             time.monotonic() - t0)
+                                             self._clock() - t0)
 
             # a coalesced follower keeps its OWN deadline: the leader
             # may have none, and "timeout_ms overrides the server
@@ -760,9 +769,9 @@ class Server:
             example = jax.tree_util.tree_map(np.asarray, example)
         timeout_s = (self._default_timeout_s if timeout_ms is None
                      else max(0.0, timeout_ms) / 1e3)
-        deadline = (None if timeout_s is None
-                    else time.monotonic() + timeout_s)
-        req = Request(example, deadline)
+        now_m = self._clock()
+        deadline = None if timeout_s is None else now_m + timeout_s
+        req = Request(example, deadline, now=now_m)
         tracer = get_tracer()
         if tracer.enabled:
             # root span of this request's trace: submit -> future settle
@@ -922,7 +931,7 @@ class Server:
                 # request must be settled by every failure path too
                 requests.extend(extras)
                 n = len(requests)
-        now = time.monotonic()
+        now = self._clock()
         for r in requests:
             self.metrics.record_time("serving.time_in_queue",
                                      now - r.enqueued_at)
@@ -946,7 +955,7 @@ class Server:
         batch_span = requests[0].batch_span
         if batch_span is not None:
             batch_span.annotate(bucket=bucket)
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # real: batch_seconds_hint sizes real waits
         # re-root this worker thread onto the micro-batch span so the
         # engine's own spans (engine.call -> engine.dispatch) nest under
         # serving.request -> serving.microbatch
@@ -969,7 +978,7 @@ class Server:
         self.metrics.record_time("serving.batch_latency", batch_s)
         self.metrics.observe("serving.batch_fill_ratio",
                              n / eng.device_batch_size)
-        done = time.monotonic()
+        done = self._clock()
         slowest: Optional[Request] = None
         slowest_s = 0.0
         for i, r in enumerate(requests):
@@ -1025,6 +1034,12 @@ class Server:
         """Queue occupancy in [0, 1] — the admission-pressure signal the
         fleet layer sheds lowest-priority traffic against."""
         return self._batcher.depth() / max(1, self._batcher.max_queue)
+
+    def wake(self) -> None:
+        """Re-evaluate the batcher's flush conditions — how a
+        virtual-time driver tells the dispatcher the injected clock
+        moved (see :meth:`DynamicBatcher.wake`)."""
+        self._batcher.wake()
 
     @property
     def cache(self):

@@ -1,0 +1,35 @@
+"""Traffic twin (ISSUE 16): deterministic virtual-time load simulation
+plus closed-loop capacity and placement control over a REAL fleet.
+
+Public surface::
+
+    from sparkdl_tpu.twin import (ScenarioConfig, run_day,
+                                  StaticPolicy, QuotaAutoscaler,
+                                  plan_placement)
+
+    result = run_day(ScenarioConfig(seed=16),
+                     policy=QuotaAutoscaler(DEFAULT_TENANT_QUOTA))
+    result.scores["slo_minutes"]     # what the day cost
+    result.event_digest              # byte-identical across runs
+"""
+
+from sparkdl_tpu.twin.clock import VirtualClock
+from sparkdl_tpu.twin.placement import (MeshSlice, ModelPlacement,
+                                        PlacementError, PlacementPlan,
+                                        plan_placement)
+from sparkdl_tpu.twin.policy import (Policy, PolicyDecision,
+                                     QuotaAutoscaler, StaticPolicy,
+                                     TickObservation)
+from sparkdl_tpu.twin.scenario import Arrivals, Scenario, ScenarioConfig
+from sparkdl_tpu.twin.sim import (DEFAULT_TENANT_QUOTA, TrafficTwin,
+                                  TwinResult, run_day)
+
+__all__ = [
+    "VirtualClock",
+    "MeshSlice", "ModelPlacement", "PlacementError", "PlacementPlan",
+    "plan_placement",
+    "Policy", "PolicyDecision", "QuotaAutoscaler", "StaticPolicy",
+    "TickObservation",
+    "Arrivals", "Scenario", "ScenarioConfig",
+    "DEFAULT_TENANT_QUOTA", "TrafficTwin", "TwinResult", "run_day",
+]

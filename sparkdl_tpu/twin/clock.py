@@ -1,0 +1,54 @@
+"""Virtual monotonic time — the axis the traffic twin replays a day on.
+
+A :class:`VirtualClock` is a zero-argument callable returning monotonic
+seconds, shaped exactly like ``time.monotonic`` so it plugs into every
+``clock=`` injection point ISSUE 16 threaded through the serving stack
+(``Fleet``/``Server``/``DynamicBatcher``/``AdmissionController``/
+``SLOEngine``).  It only moves when :meth:`advance` is called, so a
+simulated day of token-bucket refills, wait-window flushes, deadline
+expiries, and SLO burn windows plays out in however little WALL time
+the underlying work takes — and identically on every run.
+
+Starting at ``0.0`` (not some process-relative monotonic offset) makes
+every virtual timestamp scenario-relative, which is what lets two runs
+of the same seed produce byte-identical event sequences.
+"""
+
+from __future__ import annotations
+
+from sparkdl_tpu.analysis.lockcheck import named_lock
+
+
+class VirtualClock:
+    """Injectable monotonic clock that advances only on demand.
+
+    Thread-safe: the serving stack reads it from submitter, dispatcher,
+    and worker threads while the twin's driver thread advances it.
+    Reads are lock-protected so a reader can never observe a torn
+    float (and the lock is a ``named_lock`` so SPARKDL_LOCKCHECK
+    audits its ordering against the serving locks it nests inside).
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._lock = named_lock("twin.clock")
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._now
+
+    @property
+    def now(self) -> float:
+        return self()
+
+    def advance(self, dt: float) -> float:
+        """Move virtual time forward by ``dt`` seconds (never backward —
+        the clock keeps ``time.monotonic``'s contract) and return the
+        new now.  The caller is responsible for waking anything whose
+        wait windows the jump may have satisfied (``Fleet.wake``)."""
+        if dt < 0:
+            raise ValueError(f"virtual time cannot move backward "
+                             f"(dt={dt})")
+        with self._lock:
+            self._now += float(dt)
+            return self._now

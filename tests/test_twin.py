@@ -1,0 +1,397 @@
+"""Traffic-twin tests (ISSUE 16): virtual time, seeded days, closed-loop
+control, HBM-aware placement.
+
+Pins the subsystem's contracts:
+
+* ``VirtualClock``: starts at scenario time zero, only moves on
+  ``advance``, never backward;
+* clock injection (satellite 1): an ``AdmissionController`` bucket
+  refills ONLY when virtual time moves; a ``Server`` holds a parked
+  request under a frozen clock and flushes after ``advance + wake``;
+  an ``SLOEngine`` burn window is a virtual-time window;
+* scenario: one seed -> byte-identical arrival arrays, flash-crowd
+  uplift, retry feedback, the hard per-tick clip;
+* the headline determinism bar: two full simulated days against a REAL
+  fleet produce byte-identical event sequences, decisions, and scores;
+* closed loop (a): the quota autoscaler beats the static baseline on
+  SLO-minutes (and goodput) through a flash crowd + retry storm;
+* closed loop (b): the placement planner respects the per-chip HBM
+  budget — re-verified here through ``param_sharding_stats``, not the
+  planner's own claim — shards only when replication cannot fit, and
+  raises loudly on infeasible demands;
+* chaos: ``twin.arrival`` error rules drop arrivals at the door and are
+  scored; ``twin.tick`` sleep rules must not move an event byte;
+* incident rendering: a simulated day's flight events fold through
+  ``tools/blackbox.py`` into a clean timeline.
+
+Tier-1 scenarios are deliberately tiny (a dozen ticks, ~1-5k virtual
+requests, seconds of wall time); the canonical 288-tick day rides the
+``slow`` marker and the run-tests.sh twin stage's speed guard.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import faults
+from sparkdl_tpu.faults import FaultPlan
+from sparkdl_tpu.faults.sites import SITE_HELP, validate_site
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs.slo import SLO, SLOEngine
+from sparkdl_tpu.parallel.mesh import param_sharding_stats
+from sparkdl_tpu.serving import Server, TenantQuota
+from sparkdl_tpu.serving.errors import QuotaExceededError
+from sparkdl_tpu.serving.fleet.admission import AdmissionController
+from sparkdl_tpu.twin import (MeshSlice, PlacementError, QuotaAutoscaler,
+                              Scenario, ScenarioConfig, StaticPolicy,
+                              TrafficTwin, VirtualClock, plan_placement,
+                              run_day)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: tight quota (refill 45 tokens / 300 s tick) — makes the tiny
+#: flash crowd shed hard, which is the whole policy story
+TIGHT_QUOTA = TenantQuota(rate_per_s=0.15, burst=60)
+
+
+def _small_cfg(**kw):
+    base = dict(seed=5, ticks=12, tenants=16,
+                mean_arrivals_per_tick=60.0, flash_start=4, flash_end=8,
+                flash_tenants=4, canary_tick=2, stream_every=5,
+                digest_universe=64)
+    base.update(kw)
+    return ScenarioConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _restore_flight():
+    yield
+    r = flight.get_recorder()
+    if r is not None:
+        r.close()
+    flight.configure_from_env()
+
+
+def _fn(variables, x):
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ variables["w"])
+
+
+# -- virtual clock ----------------------------------------------------------
+
+def test_virtual_clock_contract():
+    clock = VirtualClock()
+    assert clock() == 0.0 and clock.now == 0.0
+    assert clock.advance(2.5) == 2.5
+    assert clock() == 2.5
+    clock.advance(0.0)  # zero advance is legal (a no-op tick)
+    with pytest.raises(ValueError, match="backward"):
+        clock.advance(-0.1)
+    assert clock.now == 2.5
+
+
+# -- satellite 1: clock injection -------------------------------------------
+
+def test_admission_bucket_refills_on_virtual_time_only():
+    clock = VirtualClock()
+    ctrl = AdmissionController(
+        default_quota=TenantQuota(rate_per_s=1.0, burst=2), clock=clock)
+    for _ in range(2):
+        ctrl.admit("t")
+        ctrl.release("t")
+    # bucket empty and the clock frozen: NO amount of wall time refills
+    with pytest.raises(QuotaExceededError):
+        ctrl.admit("t")
+    clock.advance(1.0)  # one virtual second = one token
+    ctrl.admit("t")
+    ctrl.release("t")
+    with pytest.raises(QuotaExceededError):
+        ctrl.admit("t")
+
+
+def test_server_flush_waits_for_virtual_time(rng):
+    clock = VirtualClock()
+    w = {"w": rng.normal(size=(6, 6)).astype(np.float32)}
+    x = rng.normal(size=(6,)).astype(np.float32)
+    with Server(_fn, w, max_batch_size=8, max_wait_ms=5_000.0,
+                bucket_sizes=[8], clock=clock) as srv:
+        fut = srv.submit(x)
+        # under a frozen clock the 5-virtual-second wait window never
+        # elapses, no matter how much wall time passes
+        with pytest.raises(Exception):
+            fut.result(timeout=0.3)
+        clock.advance(10.0)
+        srv.wake()
+        y = fut.result(timeout=30)
+        assert np.asarray(y).shape == (6,)
+
+
+def test_slo_engine_windows_ride_virtual_time():
+    from sparkdl_tpu.utils.metrics import Metrics
+
+    clock = VirtualClock()
+    m = Metrics()
+    eng = SLOEngine(
+        m, [SLO("avail", "availability", good="g", total="t",
+                objective=0.999)], clock=clock)
+    m.incr("g", 100)
+    m.incr("t", 100)
+    eng.evaluate()
+    clock.advance(300.0)
+    m.incr("g", 50)
+    m.incr("t", 100)  # 50% bad over the last virtual window
+    snap = eng.evaluate()
+    st = snap["objectives"][0]
+    assert snap["state"] == "breach"
+    assert st["burn_short"] > st["burn_threshold"]
+    # recovery is also a virtual-time fact
+    clock.advance(300.0)
+    m.incr("g", 100)
+    m.incr("t", 100)
+    assert eng.evaluate()["state"] == "ok"
+
+
+# -- scenario ---------------------------------------------------------------
+
+def test_scenario_seeded_and_shaped():
+    cfg = _small_cfg()
+    a, b = Scenario(cfg), Scenario(cfg)
+    total = 0
+    for tick in range(cfg.ticks):
+        arr_a = a.arrivals(tick)
+        arr_b = b.arrivals(tick)
+        for f in ("tenant", "model", "digest", "retry"):
+            np.testing.assert_array_equal(getattr(arr_a, f),
+                                          getattr(arr_b, f))
+        assert len(arr_a) <= cfg.max_arrivals_per_tick
+        assert arr_a.tenant.max(initial=0) < cfg.tenants
+        assert arr_a.digest.max(initial=0) < cfg.digest_universe
+        total += len(arr_a)
+    assert total > 0
+    np.testing.assert_array_equal(a.payloads, b.payloads)
+    # flash ticks carry the crowd
+    steady = len(a.arrivals(1))
+    flash = len(a.arrivals(cfg.flash_start))
+    assert flash > 2 * steady
+    assert a.phase(cfg.flash_start) == "flash_crowd"
+    # retry feedback adds re-presented traffic, flagged as such
+    with_retries = a.arrivals(1, retry_counts={0: 40})
+    assert with_retries.retry.sum() > 0
+    assert len(with_retries) > steady
+
+
+def test_scenario_clip_is_deterministic():
+    cfg = _small_cfg(max_arrivals_per_tick=50,
+                     mean_arrivals_per_tick=200.0)
+    s = Scenario(cfg)
+    arr = s.arrivals(1)
+    assert len(arr) == 50 and arr.clipped > 0
+    arr2 = Scenario(cfg).arrivals(1)
+    np.testing.assert_array_equal(arr.tenant, arr2.tenant)
+    assert arr.clipped == arr2.clipped
+
+
+# -- the headline bar: byte-identical days ----------------------------------
+
+def test_two_runs_byte_identical_events_decisions_scores():
+    cfg = _small_cfg()
+    r1 = run_day(cfg, policy=StaticPolicy(), default_quota=TIGHT_QUOTA)
+    r2 = run_day(cfg, policy=StaticPolicy(), default_quota=TIGHT_QUOTA)
+    assert r1.event_lines == r2.event_lines
+    assert r1.event_digest == r2.event_digest
+    assert r1.scores == r2.scores
+    assert len(r1.event_lines) == cfg.ticks
+    # the day did real work against the real fleet
+    assert r1.scores["offered"] > 500
+    assert r1.scores["stream_commits"] > 0
+    assert r1.scores["cache_hit_rate"] > 0.1  # Zipf content hit the cache
+    assert r1.scores["tenants_active"] == cfg.tenants
+    # event lines are canonical JSON with the scored fields
+    doc = json.loads(r1.event_lines[-1])
+    for key in ("tick", "vt", "phase", "slo", "decision",
+                "cache_hits_coalesced_total"):
+        assert key in doc
+    # virtual timestamps are scenario-relative and tick-spaced
+    assert json.loads(r1.event_lines[0])["vt"] == cfg.tick_s
+    assert doc["vt"] == cfg.ticks * cfg.tick_s
+
+
+def test_adaptive_run_deterministic_with_decisions():
+    cfg = _small_cfg()
+    mk = lambda: QuotaAutoscaler(TIGHT_QUOTA)  # noqa: E731
+    r1 = run_day(cfg, policy=mk(), default_quota=TIGHT_QUOTA)
+    r2 = run_day(cfg, policy=mk(), default_quota=TIGHT_QUOTA)
+    assert r1.event_lines == r2.event_lines
+    assert r1.scores == r2.scores
+    # the autoscaler actually decided things (quota raises + canary)
+    levers = [a["lever"] for line in r1.event_lines
+              for a in json.loads(line)["decision"]]
+    assert "quota" in levers
+    assert "canary" in levers
+
+
+# -- closed loop (a): policy beats static -----------------------------------
+
+def test_policy_beats_static_through_flash_crowd():
+    cfg = _small_cfg(ticks=16)
+    rs = run_day(cfg, policy=StaticPolicy(), default_quota=TIGHT_QUOTA)
+    ra = run_day(cfg, policy=QuotaAutoscaler(TIGHT_QUOTA),
+                 default_quota=TIGHT_QUOTA)
+    # the flash crowd must actually burn the static baseline, or the
+    # comparison is vacuous
+    assert rs.scores["slo_minutes"] > 0
+    assert rs.scores["shed"] > 0
+    assert ra.scores["slo_minutes"] < rs.scores["slo_minutes"]
+    assert ra.scores["goodput"] > rs.scores["goodput"]
+    assert ra.scores["fairness"] >= rs.scores["fairness"]
+
+
+# -- closed loop (b): placement ---------------------------------------------
+
+def _entries(leaf_shapes):
+    """name -> param dict; keys matter: the default partition rules
+    only split leaves named ``kernel``/``embedding``."""
+    rng = np.random.default_rng(0)
+    return {name: {leaf: rng.normal(size=s).astype(np.float32)
+                   for leaf, s in shapes.items()}
+            for name, shapes in leaf_shapes.items()}
+
+
+def test_placement_respects_hbm_budget_via_stats():
+    entries = _entries({
+        "big": {"kernel": (256, 256), "bias": (256,)},  # 256 KiB + bias
+        "small": {"kernel": (32, 32)},
+    })
+    chip = 200 * 1024
+    plan = plan_placement(entries, chip_hbm_bytes=chip,
+                          total_chip_budget=16)
+    usable = plan.usable_hbm_bytes
+    assert usable == int(chip * 0.75)
+    for p in plan.placements:
+        # re-verify against param_sharding_stats on the SAME geometry,
+        # not the planner's own bookkeeping
+        mesh = MeshSlice(data=1, model=p.model_parallel)
+        stats = param_sharding_stats(mesh, entries[p.model])
+        assert p.stats["param_bytes_per_chip"] <= usable
+        assert p.stats["param_bytes_total"] == stats["param_bytes_total"]
+    by_name = {p.model: p for p in plan.placements}
+    # 256 KiB replicated > 150 KiB usable -> the big model must shard
+    assert by_name["big"].model_parallel > 1
+    assert not by_name["big"].replicated
+    assert by_name["big"].partition_digest != "replicated"
+    # the small model replicates on one chip (the classic cheap layout)
+    assert by_name["small"].model_parallel == 1
+    assert by_name["small"].replicated
+    assert plan.chips_used <= plan.total_chip_budget
+    # plan digest is a deterministic content address
+    plan2 = plan_placement(entries, chip_hbm_bytes=chip,
+                           total_chip_budget=16)
+    assert plan.digest() == plan2.digest()
+    json.dumps(plan.as_dict())
+
+
+def test_placement_infeasible_raises():
+    # odd last dim: the divisibility rule can never split it
+    entries = _entries({"huge": {"kernel": (512, 513)}})
+    with pytest.raises(PlacementError, match="fits no allowed slice"):
+        plan_placement(entries, chip_hbm_bytes=64 * 1024,
+                       total_chip_budget=64)
+    # feasible per model but over the chip budget
+    many = _entries({f"m{i}": {"kernel": (256, 256)} for i in range(4)})
+    with pytest.raises(PlacementError, match="budget"):
+        plan_placement(many, chip_hbm_bytes=200 * 1024,
+                       total_chip_budget=2, slice_chips=(2, 4))
+    with pytest.raises(ValueError):
+        plan_placement({}, chip_hbm_bytes=1, total_chip_budget=1)
+    with pytest.raises(ValueError):
+        plan_placement(entries, chip_hbm_bytes=1, total_chip_budget=1,
+                       reserve_fraction=1.5)
+
+
+def test_mesh_slice_matches_helper_surface():
+    s = MeshSlice(data=2, model=4)
+    assert s.chips == 8
+    assert s.shape["model"] == 4 and s.axis_names == ("data", "model")
+    with pytest.raises(ValueError):
+        MeshSlice(data=0)
+
+
+# -- satellite 2: registries ------------------------------------------------
+
+def test_twin_sites_and_events_registered():
+    assert validate_site("twin.tick") == "twin.tick"
+    assert validate_site("twin.arrival") == "twin.arrival"
+    for site in ("twin.tick", "twin.arrival"):
+        assert SITE_HELP[site]
+    for ev in ("twin.scenario", "policy.adjust", "placement.plan"):
+        assert flight.validate_event(ev) == ev
+
+
+# -- chaos ------------------------------------------------------------------
+
+def test_twin_arrival_fault_drops_are_scored():
+    cfg = _small_cfg(ticks=6, canary_tick=None, stream_every=0)
+    plan = FaultPlan.parse("seed=1;twin.arrival:error:exc=transient,"
+                           "every=25")
+    with faults.active(plan):
+        r = run_day(cfg, policy=StaticPolicy())
+    assert r.scores["fault_drops"] > 0
+    # stream off: every offered arrival was either admitted or shed,
+    # and a dropped arrival counts as a shed (it feeds the retry storm)
+    assert (r.scores["offered"]
+            == r.scores["submitted"] + r.scores["shed"])
+    assert r.scores["shed"] >= r.scores["fault_drops"]
+
+
+def test_twin_tick_sleep_rule_does_not_move_an_event_byte():
+    cfg = _small_cfg(ticks=6, canary_tick=None, stream_every=0)
+    r_clean = run_day(cfg, policy=StaticPolicy(),
+                      default_quota=TIGHT_QUOTA)
+    plan = FaultPlan.parse("seed=7;twin.tick:sleep:ms=1,times=3")
+    with faults.active(plan):
+        r_chaos = run_day(cfg, policy=StaticPolicy(),
+                          default_quota=TIGHT_QUOTA)
+    assert r_clean.event_lines == r_chaos.event_lines
+    assert r_clean.event_digest == r_chaos.event_digest
+
+
+# -- blackbox ---------------------------------------------------------------
+
+def test_blackbox_timeline_folds_twin_incident(tmp_path):
+    from tools.blackbox import build_timeline
+
+    flight.configure(enabled=True, out_dir=str(tmp_path))
+    r = run_day(_small_cfg(ticks=10), policy=QuotaAutoscaler(TIGHT_QUOTA),
+                default_quota=TIGHT_QUOTA)
+    rec = flight.get_recorder()
+    dump = str(tmp_path / "flight_twin.jsonl")
+    rec.dump(dump)
+    doc = build_timeline(dump)
+    chain = doc["chain"]
+    assert "twin.scenario" in chain
+    assert "policy.adjust" in chain
+    assert "placement.plan" in chain
+    assert "slo.breach" in chain      # the flash crowd burned
+    assert "slo.recovered" in chain   # ...and the policy recovered it
+    assert doc["verdict"]["clean"] is True
+    json.dumps(doc)
+    assert r.scores["slo_minutes"] >= 0
+
+
+# -- the canonical day ------------------------------------------------------
+
+@pytest.mark.slow
+def test_canonical_day_twice_byte_identical():
+    from sparkdl_tpu.twin import DEFAULT_TENANT_QUOTA
+
+    cfg = ScenarioConfig()
+    mk = lambda: QuotaAutoscaler(DEFAULT_TENANT_QUOTA)  # noqa: E731
+    r1 = run_day(cfg, policy=mk())
+    r2 = run_day(cfg, policy=mk())
+    assert r1.event_digest == r2.event_digest
+    assert r1.scores == r2.scores
+    assert r1.scores["offered"] >= 100_000
+    assert r1.scores["tenants_active"] >= 50
