@@ -1482,6 +1482,63 @@ def bench_twin():
          })
 
 
+_HEADFANOUT_BENCH = r"""
+import json, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.serving.cache import head_fanout_benchmark
+out = head_fanout_benchmark(
+    n_requests=int(os.environ.get("SPARKDL_BENCH_FANOUT_REQUESTS", "160")),
+    universe=int(os.environ.get("SPARKDL_BENCH_FANOUT_UNIVERSE", "16")),
+    tenants=int(os.environ.get("SPARKDL_BENCH_FANOUT_TENANTS", "64")),
+    dispatch_ms=float(os.environ.get("SPARKDL_BENCH_FANOUT_DISPATCH_MS",
+                                     "10.0")))
+print(json.dumps(out))
+"""
+
+
+def bench_headfanout():
+    """Shared-backbone head fan-out (ISSUE 17): a seeded Zipf-content
+    64-tenant replay on the synthetic slow backbone.  Headline is the
+    warm-path p50 reduction vs the full-model-per-request baseline;
+    stamped alongside: the backbone dispatch ratio (dispatches ==
+    distinct content digests proves featurize-once), head-only warm
+    p50/p99, the stacked head bank's per-chip HBM bytes, and the
+    bit-identical-vs-per-tenant-oracle verdict."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ta = _CONFIG_OBS.get("trace_artifact")
+    if ta:  # child traces itself and atexit-flushes into this subdir
+        env["SPARKDL_TRACE"] = ta
+    prof = _run_json_subprocess(_HEADFANOUT_BENCH, timeout_s=480, env=env)
+    emit("headfanout",
+         "shared-backbone head fan-out warm-path p50 reduction under "
+         "Zipf-content multi-tenant replay (synthetic slow backbone)",
+         prof["p50_reduction"], "fraction of full-model p50 removed",
+         env_bound="synthetic: deterministic sleep backbone on host CPU "
+                   "(measures the feature-cache/head-bank layer, not "
+                   "the chip)",
+         extra={
+             "n_requests": prof["n_requests"],
+             "universe": prof["universe"],
+             "tenants": prof["tenants"],
+             "zipf_s": prof["zipf_s"],
+             "distinct": prof["distinct"],
+             "backbone_dispatches": prof["backbone_dispatches"],
+             "baseline_dispatches": prof["baseline_dispatches"],
+             "dispatch_ratio": prof["dispatch_ratio"],
+             "baseline_p50_ms": prof["baseline_p50_ms"],
+             "baseline_p99_ms": prof["baseline_p99_ms"],
+             "warm_p50_ms": prof["warm_p50_ms"],
+             "warm_p99_ms": prof["warm_p99_ms"],
+             "feature_hits": prof["feature_hits"],
+             "bank_param_bytes_per_chip": prof["bank_param_bytes_per_chip"],
+             "bank_capacity": prof["bank_capacity"],
+             "bank_mode": prof["bank_mode"],
+             "bit_identical": prof["bit_identical"],
+         })
+
+
 BENCHES = {
     "1": bench_config1_device,
     "1e2e": bench_config1_e2e,
@@ -1496,6 +1553,7 @@ BENCHES = {
     "cache": bench_cache,
     "ragged": bench_ragged,
     "twin": bench_twin,
+    "headfanout": bench_headfanout,
 }
 
 
@@ -1504,10 +1562,11 @@ BENCHES = {
 # queue/batching/admission/swap/dispatch), "pipeline", "cache", and
 # "ragged" simulate their device with a deterministic sleep, "streaming"
 # measures the journal'd crash-resume path on synthetic in-memory
-# chunks, and "twin" replays a whole virtual-clock day through a real
-# fleet on the CPU backend.
+# chunks, "twin" replays a whole virtual-clock day through a real
+# fleet on the CPU backend, and "headfanout" measures the feature-cache
+# + stacked-head-bank layer on a deterministic sleep backbone.
 _CHIPLESS_CONFIGS = ("serving", "fleet", "pipeline", "streaming", "cache",
-                     "ragged", "twin")
+                     "ragged", "twin", "headfanout")
 
 REPROBE_TIMEOUT_S = int(os.environ.get("SPARKDL_BENCH_REPROBE_TIMEOUT",
                                        "120"))
@@ -1556,7 +1615,7 @@ def main():
         _print_line(json.dumps({"config": "relay", "error": repr(e)[:200]}))
     _RELAY_DEAD[0] = relay_dead
     default = ("1,1e2e,2,3,4,5,serving,fleet,pipeline,streaming,cache,"
-               "ragged,twin")
+               "ragged,twin,headfanout")
     keys = [k.strip() for k in
             os.environ.get("SPARKDL_BENCH_CONFIGS", default).split(",")]
     if relay_dead:

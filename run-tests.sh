@@ -90,6 +90,12 @@ if [[ ! -f tests/test_twin.py ]]; then
        "ship untested" >&2
   exit 1
 fi
+if [[ ! -f tests/test_headfanout.py ]]; then
+  echo "FATAL: tests/test_headfanout.py missing — the head fan-out tier" \
+       "(featurize-once replay, no-backbone-recompile hot-swap, feature" \
+       "cache survival, bank fallback modes) would ship untested" >&2
+  exit 1
+fi
 
 # graftlint stage (ISSUE 5): the repo's own invariants (joined threads,
 # lockset discipline, registered fault sites, paired spans, monotonic
@@ -710,4 +716,90 @@ assert max(walls) <= BUDGET_S, (
     f"canonical day took {max(walls):.1f}s (budget {BUDGET_S:.0f}s) — "
     f"a simulated day no longer fits tier-1-compatible wall time")
 print("traffic-twin speed guard ok")
+PY
+
+# Head fan-out stage (ISSUE 17): the shared-backbone serving tier
+# re-proven under chaos, lock checking, and an overhead bound.
+#   (a) the fan-out suite re-runs with SPARKDL_FAULTS carrying a real
+#       head.dispatch rule (the tests install their own plans over it,
+#       but the env gate itself is then exercised: a bounded sleep at
+#       the head pass must stretch only wall time, never correctness)
+#       and SPARKDL_LOCKCHECK=1 so the new named locks
+#       (engine.headbank, serving.headfanout.swap) feed the lock-order
+#       graph nested inside the serving and cache locks;
+#   (b) a scoped graftlint self-check over the fan-out surfaces;
+#   (c) the fan-out overhead guard: the full submit→featurize→head
+#       path over a sleep-wrapped backbone must land within the
+#       established 1.35x sleep-math bound — the gather/vmap head pass
+#       and the feature probe may never add per-dispatch cost.
+echo "== head fan-out suite (SPARKDL_FAULTS active) =="
+SPARKDL_FAULTS="seed=8;head.dispatch:sleep:ms=1,times=2" \
+  SPARKDL_LOCKCHECK=1 \
+  timeout -k 10 300 python -m pytest tests/test_headfanout.py -q
+echo "== graftlint head fan-out modules self-check =="
+timeout -k 5 15 python tools/graftlint.py sparkdl_tpu/serving/server.py \
+  sparkdl_tpu/serving/cache.py sparkdl_tpu/serving/fleet \
+  sparkdl_tpu/parallel/engine.py \
+  --sites-file sparkdl_tpu/faults/sites.py \
+  --events-file sparkdl_tpu/obs/flight.py
+echo "== head fan-out overhead guard =="
+env -u SPARKDL_FAULTS timeout -k 10 300 python - <<'PY'
+import json
+import time
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu import faults
+from sparkdl_tpu.parallel.engine import head_fanout_backbone_fn
+from sparkdl_tpu.serving.server import HeadFanoutServer
+
+faults.clear()
+rng = np.random.default_rng(8)
+variables = {"backbone": rng.normal(size=(12, 16)).astype(np.float32)}
+heads = {f"t{i:02d}": {
+    "kernel": rng.normal(size=(16, 4)).astype(np.float32),
+    "bias": rng.normal(size=(4,)).astype(np.float32)}
+    for i in range(64)}
+rows = [rng.normal(size=(12,)).astype(np.float32) for _ in range(6 * 32)]
+dispatch_s = 0.05
+# cache OFF: every request must ride the full backbone+head path, so
+# the bound measures the fan-out machinery itself, not the cache win
+srv = HeadFanoutServer(head_fanout_backbone_fn, variables, cache=False,
+                       max_batch_size=32, max_wait_ms=5,
+                       bucket_sizes=[32], max_inflight_batches=1,
+                       max_queue=len(rows) + 16)
+try:
+    for t, h in heads.items():
+        srv.add_head(t, h)
+    srv.warmup(rows[0])
+    srv.warm_head(np.zeros(16, np.float32))
+    for b in srv.bucket_sizes:
+        eng = srv.backbone._engine_for(b)
+        real = eng.run_padded
+
+        def slow(batch, _real=real):
+            time.sleep(dispatch_s)
+            return _real(batch)
+
+        eng.run_padded = slow
+    tenants = sorted(heads)
+    t0 = time.perf_counter()
+    futs = [srv.submit(r, tenants[i % len(tenants)])
+            for i, r in enumerate(rows)]
+    for f in futs:
+        f.result(timeout=60)
+    wall = time.perf_counter() - t0
+finally:
+    srv.close()
+ideal = (len(rows) // 32) * dispatch_s
+print(json.dumps({"ideal_s": round(ideal, 3),
+                  "fanout_wall_s": round(wall, 3),
+                  "tenants": len(tenants)}))
+assert wall <= 1.35 * ideal, (
+    f"fan-out serving wall {wall:.3f}s exceeds 1.35x the "
+    f"{ideal:.3f}s sleep-math ideal — the head fan-out path has "
+    f"grown per-request overhead")
+print("head fan-out overhead guard ok")
 PY

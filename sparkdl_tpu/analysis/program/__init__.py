@@ -49,8 +49,8 @@ from sparkdl_tpu.analysis.program.audit import (GC_RULE_HELP, ProgramSpec,
                                                 audit_program,
                                                 pad_waste_audit,
                                                 retrace_audit)
-from sparkdl_tpu.analysis.program.inventory import (fleet_dispatch_specs,
-                                                    stack_programs)
+from sparkdl_tpu.analysis.program.inventory import (
+    fleet_dispatch_specs, headfanout_dispatch_specs, stack_programs)
 from sparkdl_tpu.analysis.program.lockfile import (DEFAULT_LOCKFILE,
                                                    diff_records,
                                                    read_lockfile,
@@ -66,6 +66,7 @@ __all__ = [
     "pad_waste_audit",
     "stack_programs",
     "fleet_dispatch_specs",
+    "headfanout_dispatch_specs",
     "DEFAULT_LOCKFILE",
     "read_lockfile",
     "write_lockfile",

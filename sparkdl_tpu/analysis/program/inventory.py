@@ -179,10 +179,112 @@ def fleet_dispatch_specs(models: Optional[Sequence[str]] = None,
     ``PROGRAMS.lock.json`` regenerates only if the underlying zoo ×
     bucket set itself changes (tests pin the set equality and match the
     audited executable keys/fingerprints against the committed
-    lockfile)."""
+    lockfile).
+
+    The head fan-out tier (``Fleet.add_fanout_model``) keeps the same
+    property by a different split: its backbone is one ordinary
+    dispatch program and ALL tenant heads share one vmapped gather
+    program, audited separately by :func:`headfanout_dispatch_specs` —
+    head add/swap/evict changes weights and bank capacity, never the
+    program set."""
     return zoo_dispatch_specs(max_batch_size=max_batch_size,
                               models=models, compute_dtype=compute_dtype,
                               mesh=mesh)
+
+
+#: The head fan-out proof model's shape (ISSUE 17): a 12 → 16 feature
+#: backbone (output WIDER than the input row, so the batch donation can
+#: never alias — the recorded GC001 exemption below) in front of 64
+#: stacked per-tenant 16 → 4 heads — the smallest program pair that
+#: pins the tier's two claims chip-free: the backbone-cut program's
+#: StableHLO fingerprint is what ``serving.cache.
+#: lockfile_model_fingerprint("headfanout")`` resolves (the feature-cut
+#: cache namespace and the head-swap proof both key on it), and the ONE
+#: vmapped gather program serves every tenant's head.
+HEADFANOUT_DIM_IN = 12
+HEADFANOUT_DIM_FEAT = 16
+HEADFANOUT_CLASSES = 4
+HEADFANOUT_TENANTS = 64
+
+HEADFANOUT_DONATE_REASON = (
+    "the (b, 12) f32 row batch cannot alias the (b, 16) feature output "
+    "(the feature cut widens it), and the fan-out program's gathered "
+    "head inputs are read by every padded row — XLA would drop either "
+    "donation, so the serving tier leaves both off")
+
+
+def headfanout_dispatch_specs(batch_rows: int = 32,
+                              tenants: int = HEADFANOUT_TENANTS,
+                              mesh=None) -> List[ProgramSpec]:
+    """The shared-backbone head fan-out programs (ISSUE 17), built
+    through the EXACT runtime constructors: the backbone feature cut
+    via ``build_dispatch_jit`` over ``parallel.engine.
+    head_fanout_backbone_fn`` (the module-level fn the tests, the bench
+    and ``HeadFanoutServer`` smoke paths all serve), and the stacked
+    head bank's single vmapped gather program via
+    ``build_head_fanout_jit`` over ``parallel.engine.dense_head_row``
+    at the canonical 64-tenant capacity.  The backbone record carries
+    ``model="headfanout"`` so ``lockfile_model_fingerprint`` resolves
+    the tier's committed backbone identity — the fingerprint the
+    feature-cut cache namespace and ``head_swap_report``'s
+    ``fingerprint_pinned`` witness both pin against; the head program
+    deliberately does NOT (head-program evolution must never rotate
+    the backbone's feature namespace).  Neither spec records a
+    ``bucket``: the fan-out tier reuses the serving bucket plan, whose
+    pad accounting GC004 already gates through the zoo set."""
+    from sparkdl_tpu.parallel.engine import (effective_device_batch,
+                                             resolve_engine_mesh)
+
+    mesh = resolve_engine_mesh(mesh)
+    axes = _mesh_axes(mesh)
+    b = effective_device_batch(batch_rows, mesh)
+
+    def build_backbone():
+        import jax
+        import numpy as np
+
+        from sparkdl_tpu.parallel.engine import (build_dispatch_jit,
+                                                 head_fanout_backbone_fn)
+
+        jitted = build_dispatch_jit(head_fanout_backbone_fn, mesh,
+                                    donate_batch=False)
+        variables = {"backbone": jax.ShapeDtypeStruct(
+            (HEADFANOUT_DIM_IN, HEADFANOUT_DIM_FEAT), np.float32)}
+        batch = jax.ShapeDtypeStruct((b, HEADFANOUT_DIM_IN), np.float32)
+        return jitted, (variables, batch)
+
+    def build_heads():
+        import jax
+        import numpy as np
+
+        from sparkdl_tpu.parallel.engine import (build_head_fanout_jit,
+                                                 dense_head_row)
+
+        jitted = build_head_fanout_jit(dense_head_row, mesh)
+        stacked = {
+            "kernel": jax.ShapeDtypeStruct(
+                (tenants, HEADFANOUT_DIM_FEAT, HEADFANOUT_CLASSES),
+                np.float32),
+            "bias": jax.ShapeDtypeStruct((tenants, HEADFANOUT_CLASSES),
+                                         np.float32),
+        }
+        idx = jax.ShapeDtypeStruct((b,), np.int32)
+        feats = jax.ShapeDtypeStruct((b, HEADFANOUT_DIM_FEAT), np.float32)
+        return jitted, (stacked, idx, feats)
+
+    base = dict(kind="dispatch", donate=(),
+                donate_reason=HEADFANOUT_DONATE_REASON, mesh_axes=axes)
+    return [
+        ProgramSpec(name=f"headfanout/backbone/f32/b{b}",
+                    build=build_backbone, batch_rows=b,
+                    shardings=("replicated", "batch"),
+                    group="headfanout/backbone/f32",
+                    model="headfanout", **base),
+        ProgramSpec(name=f"headfanout/heads/k{tenants}/f32/b{b}",
+                    build=build_heads, batch_rows=b,
+                    shardings=("replicated", "batch", "batch"),
+                    group=f"headfanout/heads/k{tenants}/f32", **base),
+    ]
 
 
 def generic_dispatch_specs(feature_dim: int = 16,
@@ -507,6 +609,10 @@ def stack_programs(max_batch_size: int = 32,
     # sharded-HBM proof (no replicated leaf above budget once the
     # kernel splits) is the whole point of them
     specs.extend(sharded_dispatch_specs())
+    # the head fan-out tier's program pair (ISSUE 17): the backbone cut
+    # (whose fingerprint keys the feature-cut cache namespace) and the
+    # one vmapped gather program every tenant's head shares
+    specs.extend(headfanout_dispatch_specs(mesh=mesh))
     if include_train:
         # the train batch is the estimator's default fit batch, NOT a
         # serving bucket — keep it fixed so subset audits (--models /

@@ -46,6 +46,14 @@ SITE_HELP = {
                        "open so follower coalescing is observable; an "
                        "error rule is a leader failure every follower "
                        "must see (and that must cache nothing)"),
+    "head.dispatch": ("HeadBank vmapped head-pass dispatch (gather-by-"
+                      "tenant-index over the stacked bank) — an error "
+                      "rule fails that head pass only; the backbone "
+                      "program and the bank state are untouched"),
+    "head.swap": ("head-bank mutation attempt (add/swap/evict of one "
+                  "tenant's head) — fires BEFORE any state changes, so "
+                  "an injected fault aborts the mutation with the bank "
+                  "unchanged and the old head still serving"),
     "fleet.admit": "Fleet front-door admission (tenant quota/priority gate)",
     "fleet.canary": "Fleet canary routing decision during a rollout",
     "fleet.swap": "Fleet version swap attempt (rollout promote/rollback)",

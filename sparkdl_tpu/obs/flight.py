@@ -113,6 +113,13 @@ EVENT_HELP = {
     "cache.invalidate": ("cache entries dropped (hot-swap with a "
                          "changed fingerprint, or a corrupt entry "
                          "caught by the digest re-check)"),
+    "cache.feature_hit": ("feature-cut cache served a backbone "
+                          "featurization without a backbone dispatch — "
+                          "the request pays head-milliseconds only "
+                          "(head-fanout tier; attrs carry the tenant)"),
+    "head.swap": ("a head bank mutated (add/swap/evict of one tenant's "
+                  "head) with the backbone program untouched — attrs "
+                  "carry tenant, op, and the bank size"),
     "rollout.start": "fleet canary rollout started (stable + canary live)",
     "rollout.promote": "fleet rollout promoted; old version draining",
     "rollout.rollback": "fleet rollout rolled back; canary draining",

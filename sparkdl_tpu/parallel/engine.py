@@ -304,6 +304,397 @@ def build_grouped_dispatch_jit(fn: Callable, mesh, donate_batch: bool,
         donate_argnums=(1,) if donate_batch else ())
 
 
+def dense_head_row(head, features):
+    """THE canonical per-tenant head: one dense projection applied to ONE
+    feature row (no batch axis — :func:`build_head_fanout_jit` vmaps it).
+    ``head`` is the per-tenant weight pytree ``{"kernel": (D, C),
+    "bias": (C,)}``.  Module-level on purpose: the runtime
+    :class:`HeadBank`, the audited program in ``analysis.program.
+    inventory``, and the zoo's feature-cut bundle all reference this ONE
+    function object, so the lockfile-pinned head program is the program
+    served.
+
+    Spelled as an explicit broadcast-multiply-reduce rather than ``@``
+    ON PURPOSE: the vmapped form (a per-row head gathered out of the
+    bank) and the unbatched form (an independent full-model oracle)
+    then lower to the SAME reduction order, so fan-out outputs are
+    bit-identical to per-tenant oracles — the headline proof.  With
+    ``@``, XLA picks a batched-matmul kernel for the vmapped head and a
+    plain gemm for the oracle, whose accumulation orders differ by an
+    ulp (measured on CPU XLA), silently breaking the bit-identity
+    contract."""
+    import jax.numpy as jnp
+
+    return (jnp.sum(features[:, None] * head["kernel"], axis=0)
+            + head["bias"])
+
+
+def head_fanout_backbone_fn(variables, batch):
+    """The chip-free backbone stand-in for the head fan-out tier's
+    deterministic proofs (tests/bench/inventory): a dense tanh
+    featurizer.  Module-level for the same reason as
+    :func:`dense_head_row` — the audited backbone-cut program and the
+    sleep-wrapped backbone the replay tests serve are the SAME fn, so
+    jit-object identity is meaningful evidence."""
+    import jax.numpy as jnp
+
+    return jnp.tanh(batch @ variables["backbone"])
+
+
+def head_fanout_oracle_fn(variables, row):
+    """The INDEPENDENT per-tenant full-model oracle the fan-out tier's
+    bit-identity proofs compare against: one unbatched row through the
+    fused weights ``{"backbone", "kernel", "bias"}`` — the program shape
+    a dedicated per-tenant full-model deployment would serve.  Jitted
+    independently by each test/bench (never through
+    :func:`build_head_fanout_jit`), so agreement with the fan-out path
+    is evidence, not tautology."""
+    import jax.numpy as jnp
+
+    feats = jnp.tanh(row @ variables["backbone"])
+    return dense_head_row(
+        {"kernel": variables["kernel"], "bias": variables["bias"]}, feats)
+
+
+def build_head_fanout_jit(head_fn: Callable, mesh):
+    """THE stacked-head dispatch program: gather-by-tenant-index + vmap,
+    so K tenants' rows in one batch cost ONE head pass.
+
+    ``fanout(stacked, idx, feats)`` takes the head bank (every tenant's
+    head pytree stacked along a leading capacity axis, replicated),
+    a per-row ``int32`` tenant-index vector, and the feature rows
+    (both data-sharded); it gathers each row's head out of the bank and
+    applies ``vmap(head_fn)``.  Gather + vmap lowers to the same
+    per-row contraction a dedicated per-tenant program would emit —
+    the bit-identity tests against independent full-model oracles pin
+    that down.  One constructor shared with ``analysis.program`` (like
+    :func:`build_dispatch_jit`), so the audited stacked program cannot
+    drift from the served one."""
+    import jax
+
+    def fanout(stacked, idx, feats):
+        gathered = jax.tree_util.tree_map(lambda leaf: leaf[idx], stacked)
+        return jax.vmap(head_fn)(gathered, feats)
+
+    # donate nothing: the stacked bank is long-lived state shared by
+    # every dispatch, and the padded feature rows are caller-owned
+    return jax.jit(
+        fanout,
+        donate_argnums=(),
+        in_shardings=(mesh_lib.replicated_sharding(mesh),
+                      mesh_lib.batch_sharding(mesh),
+                      mesh_lib.batch_sharding(mesh)),
+        out_shardings=mesh_lib.batch_sharding(mesh))
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class HeadBank:
+    """Per-tenant head weights stacked into ONE device pytree served by
+    ONE vmapped program (:func:`build_head_fanout_jit`).
+
+    The bank holds K tenants' head pytrees stacked along a leading
+    capacity axis (capacity = next power of two, so adds recompile the
+    HEAD program at most log2(K) times and the backbone never).  A
+    mixed-tenant feature batch dispatches as gather-by-tenant-index —
+    one head pass regardless of how many tenants' rows it carries.
+
+    Degraded mode instead of a crash (tested): a head whose pytree
+    structure/shape/dtype cannot stack with the bank ("indivisible"),
+    or a bank whose stacked bytes would exceed ``hbm_budget_bytes``
+    (checked via ``mesh.param_sharding_stats``), flips the bank to
+    per-tenant fallback — every tenant is served through the SAME
+    fan-out jit object as a bank of one, so program identity and
+    bit-identity survive, only the one-pass batching is lost.
+
+    Thread-safety: all mutation and dispatch run under
+    ``named_lock("engine.headbank")``, so a hot-swap under load is
+    atomic — in-flight dispatches see the old bank or the new one,
+    never a torn index."""
+
+    def __init__(self, head_fn: Optional[Callable] = None, mesh=None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 metrics: Optional[Metrics] = None):
+        self.head_fn = head_fn if head_fn is not None else dense_head_row
+        self.mesh = resolve_engine_mesh(mesh)
+        self.hbm_budget_bytes = (None if hbm_budget_bytes is None
+                                 else int(hbm_budget_bytes))
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._lock = named_lock("engine.headbank")
+        self._hosts: Dict[str, Any] = {}    # tenant -> host head pytree
+        self._index: Dict[str, int] = {}    # tenant -> row in the bank
+        self._order: list = []              # tenants in stacking order
+        self._stacked = None                # device pytree (capacity, ...)
+        self._capacity = 0
+        self._leaf_sig = None               # pinned (treedef, shapes, dtypes)
+        self._fallback = False
+        self._fallback_reason: Optional[str] = None
+        # Same module-cache recipe as InferenceEngine: one jit object per
+        # (head_fn, mesh), shared across banks/servers — the head-swap
+        # no-recompile proof compares id() of this object.
+        mesh_key = (tuple(d.id for d in self.mesh.devices.flat),
+                    tuple(self.mesh.axis_names),
+                    tuple(self.mesh.devices.shape))
+        key = (id(self.head_fn),) + mesh_key + ("fanout",)
+        jitted = _JIT_CACHE.get(key)
+        if jitted is None:
+            jitted = build_head_fanout_jit(self.head_fn, self.mesh)
+            _JIT_CACHE.put(key, jitted)
+        self._fanout = jitted
+
+    # -- introspection ---------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+    @property
+    def mode(self) -> str:
+        with self._lock:
+            return "fallback" if self._fallback else "stacked"
+
+    def tenants(self) -> list:
+        with self._lock:
+            return list(self._order)
+
+    def jit_info(self) -> Dict[str, Any]:
+        """The head half of the no-recompile proof (the shape
+        ``Server.executable_state`` uses for backbone buckets): the
+        fan-out jit object's id plus its executable-cache size.  A head
+        add/swap may grow ``executables`` (that's the HEAD program, by
+        design at most once per capacity doubling); ``jit_id`` must
+        never change."""
+        try:
+            size = int(self._fanout._cache_size())
+        except (AttributeError, TypeError):  # older jax: identity only
+            size = None
+        return {"jit_id": id(self._fanout), "executables": size,
+                "mode": self.mode}
+
+    def stats(self) -> Dict[str, Any]:
+        """Stacked-bank HBM accounting via ``mesh.param_sharding_stats``
+        — the same ledger GC005 audits, so the budget the bank enforces
+        is the budget the program auditor sees."""
+        with self._lock:
+            if self._fallback or not self._order:
+                tree = dict(self._hosts) if self._hosts else None
+            else:
+                tree = self._stack_hosts(self._capacity)
+            if tree is None:
+                param = {"param_bytes_total": 0, "param_bytes_per_chip": 0}
+            else:
+                param = mesh_lib.param_sharding_stats(self.mesh, tree)
+            out = dict(param)
+            out.update({
+                "tenants": len(self._order),
+                "capacity": self._capacity,
+                "mode": "fallback" if self._fallback else "stacked",
+                "fallback_reason": self._fallback_reason,
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+            })
+            return out
+
+    # -- mutation --------------------------------------------------------
+
+    def add_head(self, tenant: str, weights) -> None:
+        """Register a NEW tenant's head.  Raises ``ValueError`` if the
+        tenant already has one (use :meth:`swap_head`)."""
+        self._mutate(tenant, weights, op="add")
+
+    def swap_head(self, tenant: str, weights) -> None:
+        """Hot-swap an EXISTING tenant's head.  Raises ``KeyError`` if
+        the tenant is unknown (use :meth:`add_head`)."""
+        self._mutate(tenant, weights, op="swap")
+
+    def remove_head(self, tenant: str) -> None:
+        """Evict a departed tenant: its row leaves the bank and the
+        remaining tenants re-stack (capacity may shrink)."""
+        self._mutate(tenant, None, op="remove")
+
+    def _mutate(self, tenant: str, weights, op: str) -> None:
+        import jax
+
+        tenant = str(tenant)
+        with self._lock:
+            # Fault site fires BEFORE any state changes: an injected
+            # error aborts the mutation with the bank unchanged.
+            inject("head.swap")
+            if op == "remove":
+                if tenant not in self._hosts:
+                    raise KeyError(f"head bank has no tenant {tenant!r}")
+                del self._hosts[tenant]
+                self._order.remove(tenant)
+            else:
+                if op == "add" and tenant in self._hosts:
+                    raise ValueError(
+                        f"tenant {tenant!r} already has a head; "
+                        "swap_head() replaces it")
+                if op == "swap" and tenant not in self._hosts:
+                    raise KeyError(f"head bank has no tenant {tenant!r}")
+                host = jax.tree_util.tree_map(np.asarray, weights)
+                sig = self._signature(host)
+                if self._leaf_sig is None:
+                    self._leaf_sig = sig
+                elif sig != self._leaf_sig and not self._fallback:
+                    self._degrade(
+                        f"tenant {tenant!r} head does not stack with the "
+                        f"bank (pytree/shape/dtype mismatch)")
+                self._hosts[tenant] = host
+                if op == "add":
+                    self._order.append(tenant)
+            if not self._fallback:
+                cap = _next_pow2(max(1, len(self._order)))
+                over = self._budget_excess(cap)
+                if over is not None:
+                    self._degrade(
+                        f"stacked bank would hold {over} bytes per chip, "
+                        f"over hbm_budget_bytes={self.hbm_budget_bytes}")
+            self._rebuild()
+            self.metrics.incr(f"headbank.{op}")
+            flight_emit("head.swap", tenant=tenant, op=op,
+                        tenants=len(self._order),
+                        mode="fallback" if self._fallback else "stacked")
+
+    def _signature(self, host):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(host)
+        return (treedef,
+                tuple(tuple(np.shape(x)) for x in leaves),
+                tuple(str(np.asarray(x).dtype) for x in leaves))
+
+    def _degrade(self, reason: str) -> None:
+        self._fallback = True
+        self._fallback_reason = reason
+        self.metrics.incr("headbank.fallbacks")
+        logger.warning("HeadBank degrading to per-tenant dispatch: %s",
+                       reason)
+
+    def _budget_excess(self, capacity: int):
+        """Bytes-per-chip the stacked bank would occupy if it exceeds the
+        budget, else None.  Uses ``param_sharding_stats`` (replicated
+        layout) so the number matches GC005's ledger."""
+        if self.hbm_budget_bytes is None or not self._order:
+            return None
+        tree = self._stack_hosts(capacity)
+        stats = mesh_lib.param_sharding_stats(self.mesh, tree)
+        per_chip = int(stats["param_bytes_per_chip"])
+        return per_chip if per_chip > self.hbm_budget_bytes else None
+
+    def _stack_hosts(self, capacity: int):
+        import jax
+
+        heads = [self._hosts[t] for t in self._order]
+        pad = heads[0]
+        rows = heads + [pad] * (capacity - len(heads))
+        return jax.tree_util.tree_map(
+            lambda *ls: np.stack([np.asarray(x) for x in ls]), *rows)
+
+    def _rebuild(self) -> None:
+        import jax
+
+        self._index = {t: i for i, t in enumerate(self._order)}
+        if self._fallback or not self._order:
+            self._stacked = None
+            self._capacity = 0 if not self._order else self._capacity
+            if not self._order:
+                self._capacity = 0
+            return
+        cap = _next_pow2(len(self._order))
+        stacked_host = self._stack_hosts(cap)
+        self._stacked = jax.device_put(
+            stacked_host, mesh_lib.replicated_sharding(self.mesh))
+        self._capacity = cap
+
+    # -- dispatch --------------------------------------------------------
+
+    def _row_bucket(self, n: int) -> int:
+        """Pad row counts to a power of two rounded to the data axis, so
+        the head program compiles O(log) executables, not one per ragged
+        batch size."""
+        dp = self.mesh.shape[mesh_lib.DATA_AXIS]
+        p = _next_pow2(max(1, n))
+        rem = p % dp
+        return p + (dp - rem) if rem else p
+
+    def dispatch(self, features, tenants) -> np.ndarray:
+        """One head pass over a mixed-tenant feature batch.
+
+        ``features`` is ``(n, ...)`` host rows (a single row is
+        promoted); ``tenants`` names each row's head.  Returns host
+        outputs row-aligned with the input.  Raises ``KeyError`` for a
+        tenant with no registered head (a departed tenant must fail
+        loudly, not serve a stale row)."""
+        import jax
+
+        features = np.asarray(features)
+        if features.ndim == 1:
+            features = features[None]
+        tenants = [str(t) for t in tenants]
+        if len(tenants) != int(features.shape[0]):
+            raise ValueError(
+                f"{features.shape[0]} feature rows but "
+                f"{len(tenants)} tenants")
+        with self._lock:
+            inject("head.dispatch")
+            missing = sorted({t for t in tenants if t not in self._hosts})
+            if missing:
+                raise KeyError(
+                    f"head bank has no head for tenant(s) {missing}")
+            self.metrics.incr("headbank.dispatches")
+            self.metrics.incr("headbank.rows", len(tenants))
+            if self._fallback:
+                return self._dispatch_fallback(features, tenants)
+            n = int(features.shape[0])
+            idx = np.asarray([self._index[t] for t in tenants],
+                             dtype=np.int32)
+            padded = self._row_bucket(n)
+            if padded != n:
+                features = np.concatenate(
+                    [features,
+                     np.zeros((padded - n,) + features.shape[1:],
+                              dtype=features.dtype)])
+                idx = np.concatenate(
+                    [idx, np.zeros(padded - n, dtype=np.int32)])
+            out = self._fanout(self._stacked, idx, features)
+            return np.asarray(out)[:n]
+
+    def _dispatch_fallback(self, features, tenants) -> np.ndarray:
+        """Per-tenant degraded path: each tenant's rows go through the
+        SAME fan-out jit as a bank of one (same program identity, same
+        numerics) — one head pass per tenant instead of one total."""
+        import jax
+
+        groups: Dict[str, list] = {}
+        for i, t in enumerate(tenants):
+            groups.setdefault(t, []).append(i)
+        out = None
+        for t, rows in groups.items():
+            sel = np.asarray(rows, dtype=np.int64)
+            feats_t = features[sel]
+            n = int(feats_t.shape[0])
+            padded = self._row_bucket(n)
+            if padded != n:
+                feats_t = np.concatenate(
+                    [feats_t,
+                     np.zeros((padded - n,) + feats_t.shape[1:],
+                              dtype=feats_t.dtype)])
+            bank1 = jax.tree_util.tree_map(
+                lambda leaf: np.asarray(leaf)[None], self._hosts[t])
+            idx = np.zeros(padded, dtype=np.int32)
+            res = np.asarray(self._fanout(bank1, idx, feats_t))[:n]
+            if out is None:
+                out = np.zeros((len(tenants),) + res.shape[1:],
+                               dtype=res.dtype)
+            out[sel] = res
+        return out
+
+
 def batches_per_dispatch_from_env() -> int:
     """``SPARKDL_BATCHES_PER_DISPATCH`` (clamped to >= 1) — the one
     parser every engine-constructing site shares, so cache keys and

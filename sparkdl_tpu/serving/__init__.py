@@ -35,6 +35,13 @@ Public surface:
   bounded entries+bytes LRU keyed on ``utils.digest`` content digests,
   N concurrent identical requests -> one dispatch, hot-swap survival
   pinned against ``PROGRAMS.lock.json``, ``SPARKDL_CACHE`` env gate.
+* :class:`HeadFanoutServer` (ISSUE 17) — the shared-backbone head
+  fan-out tier: featurize each distinct input ONCE at the zoo's feature
+  cut (cached under the backbone's lockfile fingerprint + weight
+  digest), then serve per-tenant classifier heads from a stacked
+  :class:`~sparkdl_tpu.parallel.engine.HeadBank` via one vmapped
+  gather-by-tenant program; ``add_head``/``swap_head`` hot-swap can
+  never recompile the backbone (witnessed per swap).
 """
 
 from sparkdl_tpu.serving.adapters import from_transformer
@@ -44,7 +51,8 @@ from sparkdl_tpu.serving.errors import (DeadlineExceededError,
                                         DispatchTimeoutError, QueueFullError,
                                         QuotaExceededError, ServerClosedError,
                                         ServiceUnavailableError, ServingError)
-from sparkdl_tpu.serving.server import Server, bucket_plan
+from sparkdl_tpu.serving.server import (HeadFanoutServer, Server,
+                                        bucket_plan)
 # the fleet package imports serving.server/serving.errors, so it must
 # come last here
 from sparkdl_tpu.serving.fleet import (Fleet, ModelRegistry, ModelVersion,
@@ -52,6 +60,7 @@ from sparkdl_tpu.serving.fleet import (Fleet, ModelRegistry, ModelVersion,
 
 __all__ = [
     "Server",
+    "HeadFanoutServer",
     "bucket_plan",
     "InferenceCache",
     "from_transformer",

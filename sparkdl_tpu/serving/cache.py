@@ -474,6 +474,28 @@ def lockfile_model_fingerprint(model: str,
     return h.hexdigest()
 
 
+def feature_namespace(model_desc: str,
+                      fingerprint: Optional[str],
+                      weights_digest: str) -> Tuple[str, str, str, str]:
+    """The FEATURE-CUT cache namespace (head fan-out tier, ISSUE 17):
+    ``("features", model_desc, backbone_program_fingerprint,
+    backbone_weights_digest)``.
+
+    Keyed on the backbone's identity and NOTHING about the heads — a
+    head add/swap/evict changes neither component, so feature entries
+    stay warm across head churn (a hot content digest keeps paying the
+    backbone zero times); a backbone WEIGHT change rotates
+    ``weights_digest`` and a backbone PROGRAM change rotates the
+    lockfile fingerprint, either of which moves the namespace so stale
+    features can never serve.  ``fingerprint=None`` (no audited
+    programs for this backbone) pins ``"unpinned"`` — the namespace
+    still rotates on weight changes, it just carries no committed
+    StableHLO identity."""
+    return ("features", str(model_desc),
+            fingerprint if fingerprint else "unpinned",
+            str(weights_digest))
+
+
 # -- module default (the faults.inject / SPARKDL_TRACE pattern) ------------
 _UNSET = object()   # before the first ask consults SPARKDL_CACHE
 _default: Any = _UNSET
@@ -695,4 +717,165 @@ def zipfian_cache_benchmark(n_requests: int = 160,
         "bit_identical": bit_identical,
         "cache_entries": cache_entries,
         "cache_bytes": cache_bytes,
+    }
+
+
+def head_fanout_benchmark(n_requests: int = 160,
+                          universe: int = 16,
+                          tenants: int = 64,
+                          zipf_s: float = 1.1,
+                          dispatch_ms: float = 10.0,
+                          seed: int = 0,
+                          max_batch_size: int = 8
+                          ) -> Dict[str, Any]:
+    """Deterministic chip-free proof of the shared-backbone fan-out
+    tier (ISSUE 17) — the headline replay the tests assert and the
+    ``headfanout`` bench config stamps.
+
+    A seeded Zipf-content, ``tenants``-tenant replay is served through
+    a :class:`~sparkdl_tpu.serving.server.HeadFanoutServer` whose
+    backbone engines are wrapped with a blocking ``dispatch_ms`` sleep
+    (the synthetic slow device — the same trick as
+    :func:`zipfian_cache_benchmark`, so the result is stable on any
+    host):
+
+    * FULL-MODEL BASELINE: the same replay through an UNCACHED fan-out
+      server — every request pays the backbone sleep, the per-request
+      p50/p99 of a model-copy-per-tenant deployment;
+    * COLD PASS (feature cache on, empty): the replay is sequential,
+      so single-flight makes the floor exact — backbone dispatches MUST
+      equal the number of distinct content digests (the "featurize
+      once" claim, asserted here, not just reported);
+    * WARM PASS: the replay again — ZERO further backbone dispatches,
+      and the per-request p50/p99 is head-milliseconds only.
+
+    Every output row (all three passes) is verified BIT-identical to
+    an INDEPENDENT per-tenant full-model oracle
+    (``parallel.engine.head_fanout_oracle_fn``, jitted on its own, one
+    unbatched row at a time) before timings are reported: the fan-out
+    tier must be a pure cost optimization, never an approximation."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.parallel.engine import (head_fanout_backbone_fn,
+                                             head_fanout_oracle_fn)
+    from sparkdl_tpu.serving.server import HeadFanoutServer
+
+    d_in, d_feat, classes = 12, 16, 4
+    rng = np.random.default_rng(seed)
+    variables = {"backbone": rng.normal(
+        size=(d_in, d_feat)).astype(np.float32)}
+    heads = {f"t{i:03d}": {
+        "kernel": rng.normal(size=(d_feat, classes)).astype(np.float32),
+        "bias": rng.normal(size=(classes,)).astype(np.float32),
+    } for i in range(tenants)}
+    payloads = [rng.normal(size=(d_in,)).astype(np.float32)
+                for _ in range(universe)]
+    ranks = np.arange(1, universe + 1, dtype=np.float64)
+    probs = ranks ** -float(zipf_s)
+    probs /= probs.sum()
+    seq = [(int(c), f"t{int(t):03d}") for c, t in zip(
+        rng.choice(universe, size=n_requests, p=probs),
+        rng.integers(0, tenants, size=n_requests))]
+    distinct = len({c for c, _ in seq})
+
+    # no donation: the oracle reuses its weights for every row
+    oracle = jax.jit(head_fanout_oracle_fn, donate_argnums=())
+
+    def oracle_row(content: int, tenant: str) -> np.ndarray:
+        h = heads[tenant]
+        return np.asarray(oracle(
+            {"backbone": variables["backbone"], **h},
+            jnp.asarray(payloads[content])))
+
+    def build(cache):
+        srv = HeadFanoutServer(
+            head_fanout_backbone_fn, variables, model_desc="headfanout",
+            cache=cache, max_batch_size=max_batch_size, max_wait_ms=0.5,
+            max_queue=n_requests + 16)
+        for t, h in heads.items():
+            srv.add_head(t, h)
+        srv.warmup(payloads[0])  # compile BEFORE the sleep wrap below
+        srv.warm_head(np.zeros(d_feat, np.float32))
+        calls = [0]
+        for b in srv.bucket_sizes:
+            eng = srv.backbone._engine_for(b)
+            real = eng.run_padded
+
+            def slow(batch, _real=real):  # the synthetic slow device
+                calls[0] += 1
+                _time.sleep(dispatch_ms / 1e3)
+                return _real(batch)
+
+            eng.run_padded = slow
+        return srv, calls
+
+    def replay(srv):
+        lat, out = [], []
+        for content, tenant in seq:
+            t0 = _time.perf_counter()
+            y = srv.predict(payloads[content], tenant)
+            lat.append(_time.perf_counter() - t0)
+            out.append(np.asarray(y))
+        return lat, out
+
+    def pcts(lat):
+        return (round(float(np.percentile(lat, 50)) * 1e3, 3),
+                round(float(np.percentile(lat, 99)) * 1e3, 3))
+
+    # full-model baseline: no feature cache, every request pays the
+    # backbone — the per-tenant-model-copy cost shape
+    srv, calls = build(cache=False)
+    base_lat, base_out = replay(srv)
+    baseline_dispatches = calls[0]
+    srv.close()
+
+    cache = InferenceCache()
+    srv, calls = build(cache=cache)
+    _, cold_out = replay(srv)
+    cold_dispatches = calls[0]
+    # THE headline identity: sequential replay + single-flight means a
+    # hot content digest pays the backbone exactly once EVER
+    if cold_dispatches != distinct:
+        raise AssertionError(
+            f"backbone dispatched {cold_dispatches} times for "
+            f"{distinct} distinct content digests")
+    warm_lat, warm_out = replay(srv)
+    if calls[0] != cold_dispatches:
+        raise AssertionError(
+            f"warm replay re-dispatched the backbone "
+            f"({calls[0] - cold_dispatches} extra)")
+    snap = srv.metrics.snapshot_raw()["counters"]
+    feature_hits = int(snap.get("headfanout.feature_hits", 0))
+    bank = srv.head_stats()
+    srv.close()
+
+    bit_identical = all(
+        np.array_equal(y, oracle_row(c, t))
+        for outs in (base_out, cold_out, warm_out)
+        for (c, t), y in zip(seq, outs))
+    base_p50, base_p99 = pcts(base_lat)
+    warm_p50, warm_p99 = pcts(warm_lat)
+    return {
+        "n_requests": n_requests,
+        "universe": universe,
+        "tenants": tenants,
+        "zipf_s": zipf_s,
+        "distinct": distinct,
+        "dispatch_ms": dispatch_ms,
+        "backbone_dispatches": cold_dispatches,
+        "baseline_dispatches": baseline_dispatches,
+        "dispatch_ratio": round(cold_dispatches / distinct, 4),
+        "baseline_p50_ms": base_p50,
+        "baseline_p99_ms": base_p99,
+        "warm_p50_ms": warm_p50,
+        "warm_p99_ms": warm_p99,
+        "p50_reduction": round(1.0 - warm_p50 / base_p50, 4),
+        "feature_hits": feature_hits,
+        "bank_param_bytes_per_chip": bank.get("param_bytes_per_chip"),
+        "bank_capacity": bank.get("capacity"),
+        "bank_mode": bank.get("mode"),
+        "bit_identical": bit_identical,
     }

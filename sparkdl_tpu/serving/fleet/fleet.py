@@ -42,9 +42,10 @@ from sparkdl_tpu.obs.trace import get_tracer
 from sparkdl_tpu.serving.errors import ServerClosedError
 from sparkdl_tpu.serving.fleet.admission import (AdmissionController,
                                                  TenantQuota)
-from sparkdl_tpu.serving.fleet.registry import ModelRegistry, ModelVersion
+from sparkdl_tpu.serving.fleet.registry import (HeadVersion, ModelRegistry,
+                                                ModelVersion)
 from sparkdl_tpu.serving.fleet.rollout import Rollout
-from sparkdl_tpu.serving.server import Server
+from sparkdl_tpu.serving.server import HeadFanoutServer, Server
 from sparkdl_tpu.utils.health import HealthTracker
 from sparkdl_tpu.utils.logging import get_logger
 from sparkdl_tpu.utils.metrics import Metrics
@@ -212,6 +213,132 @@ class Fleet:
         return self.registry.register(name, variables=variables,
                                       label=label)
 
+    # -- head fan-out deployment (ISSUE 17) --------------------------------
+    def add_fanout_model(self, name: str, model: Any, variables: Any = None,
+                         *, head_fn: Optional[Callable] = None,
+                         hbm_budget_bytes: Optional[int] = None,
+                         label: Optional[str] = None,
+                         warm_example: Any = None,
+                         model_desc: Optional[str] = None,
+                         **server_kwargs) -> ModelVersion:
+        """Deploy ``name`` as a HEAD FAN-OUT entry: one shared backbone
+        at the feature cut behind a
+        :class:`~sparkdl_tpu.serving.server.HeadFanoutServer`, serving
+        per-tenant heads from a stacked
+        :class:`~sparkdl_tpu.parallel.engine.HeadBank` — thousands of
+        tenant models for one backbone's HBM and FLOPs.
+
+        Versioning for these entries is HEAD-ONLY (:meth:`add_head` /
+        :meth:`swap_head`): the backbone's weights and program are
+        pinned at deploy time, which is precisely what makes head churn
+        provably recompile-free.  ``start_rollout`` refuses fan-out
+        entries for the same reason.  The feature-cut cache namespace
+        is backbone identity (``serving.cache.feature_namespace``), NOT
+        the fleet's per-version prefix — a later deploy of the same
+        backbone (any fleet) serves the warm entries."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("fleet is closed")
+            if name in self._models:
+                raise ValueError(
+                    f"model {name!r} already deployed; fan-out entries "
+                    f"version by HEAD (add_head/swap_head)")
+        mv = self.registry.register(name, model, variables,
+                                    featurize=True, label=label)
+        entry = self.registry.entry(name)
+        # same precedence as _build_server, minus the per-version cache
+        # namespace (HeadFanoutServer derives the feature-cut one)
+        dtype_keys = ("compute_dtype", "output_host_dtype")
+        caller_set_dtype = any(k in server_kwargs
+                               or k in self._server_defaults
+                               for k in dtype_keys)
+        kw = dict(self._server_defaults)
+        for k, v in entry.engine_overrides.items():
+            if k in dtype_keys and caller_set_dtype:
+                continue
+            kw[k] = v
+        kw.update(server_kwargs)
+        kw.setdefault("cache",
+                      self._cache if self._cache is not None else False)
+        server = None
+        try:
+            server = HeadFanoutServer(
+                entry.fn, mv.variables, head_fn=head_fn,
+                hbm_budget_bytes=hbm_budget_bytes,
+                # zoo entries keep the zoo name as the lockfile-facing
+                # desc; callables let the server derive the fn name
+                model_desc=(model_desc if model_desc is not None
+                            else (model if isinstance(model, str)
+                                  else None)),
+                **kw)
+            if warm_example is not None:
+                server.warmup(warm_example)
+            state = _ModelState(entry, mv.version, server, server_kwargs)
+            with self._lock:
+                closed = self._closed
+                dup = name in self._models
+                if not closed and not dup:
+                    self._models[name] = state
+            if dup:
+                raise ValueError(
+                    f"model {name!r} already deployed; fan-out entries "
+                    f"version by HEAD (add_head/swap_head)")
+            if closed:
+                raise ServerClosedError("fleet is closed")
+        except BaseException:  # noqa: BLE001 — cleaned up, re-raised
+            if server is not None:
+                server.close(drain=False)
+            self.registry.discard(name, mv.version)
+            raise
+        logger.info("fleet: deployed fan-out entry %s v%d", name,
+                    mv.version)
+        return mv
+
+    def _fanout_state(self, name: str) -> _ModelState:
+        state = self._state(name)
+        if not isinstance(state.server, HeadFanoutServer):
+            raise TypeError(
+                f"model {name!r} is not a head fan-out entry; deploy "
+                f"with add_fanout_model() to use per-tenant heads")
+        return state
+
+    def add_head(self, name: str, tenant: str, weights, *,
+                 label: Optional[str] = None) -> Dict[str, Any]:
+        """Register + serve a NEW tenant head under fan-out entry
+        ``name``.  Returns the ``head_swap_report`` no-backbone-
+        recompile proof, extended with the catalog head version."""
+        return self._head_op("add", name, tenant, weights, label)
+
+    def swap_head(self, name: str, tenant: str, weights, *,
+                  label: Optional[str] = None) -> Dict[str, Any]:
+        """Hot-swap ``tenant``'s head under load.  The backbone cannot
+        recompile (proven in the returned report) and the feature-cut
+        cache stays warm — the namespace never saw the head."""
+        return self._head_op("swap", name, tenant, weights, label)
+
+    def remove_head(self, name: str, tenant: str) -> Dict[str, Any]:
+        """Evict a departed tenant's head from the bank."""
+        return self._head_op("remove", name, tenant, None, None)
+
+    def _head_op(self, op: str, name: str, tenant: str, weights,
+                 label: Optional[str]) -> Dict[str, Any]:
+        state = self._fanout_state(name)
+        server: HeadFanoutServer = state.server
+        if op == "add":
+            report = server.add_head(tenant, weights)
+        elif op == "swap":
+            report = server.swap_head(tenant, weights)
+        else:
+            report = server.remove_head(tenant)
+        if op != "remove":
+            hv: HeadVersion = self.registry.register_head(
+                name, tenant, weights, label=label)
+            report["head_version"] = hv.version
+        with self._lock:
+            state.last_swap_report = report
+        self.metrics.incr("fleet.head_swaps")
+        return report
+
     def _build_server(self, entry, mv: ModelVersion,
                       server_kwargs: Dict[str, Any]) -> Server:
         # precedence, most specific wins: explicit per-entry
@@ -321,6 +448,14 @@ class Fleet:
             raise ValueError(f"canary fraction must be in [0, 1], got "
                              f"{canary_fraction}")
         state = self._state(name)
+        if isinstance(state.server, HeadFanoutServer):
+            # the fan-out contract: the backbone is IMMUTABLE after
+            # deploy (that immutability is the no-recompile proof) —
+            # per-tenant versioning goes through swap_head instead
+            raise RuntimeError(
+                f"model {name!r} is a head fan-out entry; its backbone "
+                f"never versions — hot-swap per-tenant heads with "
+                f"swap_head() instead")
         with self._lock:
             if state.rollout is not None:
                 raise RuntimeError(
@@ -488,7 +623,13 @@ class Fleet:
                                      priority=quota.priority)
             try:
                 with tracer.use(span):
-                    fut = server.submit(example, timeout_ms=timeout_ms)
+                    if isinstance(server, HeadFanoutServer):
+                        # fan-out entries dispatch the admission tenant's
+                        # OWN head after the shared backbone featurizes
+                        fut = server.submit(example, tenant,
+                                            timeout_ms=timeout_ms)
+                    else:
+                        fut = server.submit(example, timeout_ms=timeout_ms)
                 break
             except ServerClosedError:
                 span.finish("rejected")
@@ -671,6 +812,12 @@ class Fleet:
                 "latency_ms": dist_ms(srv.metrics,
                                       "serving.request_latency"),
             }
+            if isinstance(srv, HeadFanoutServer):
+                model_section[name]["headfanout"] = {
+                    "tenants": srv.tenants(),
+                    "bank": srv.head_state(),
+                    "feature_namespace": list(srv.feature_namespace),
+                }
         snap = metrics_snapshot(self.metrics)
         return {
             "fleet": {

@@ -51,12 +51,43 @@ class ModelVersion:
         return f"ModelVersion({self.name!r}, v{self.version})"
 
 
+class HeadVersion:
+    """One per-tenant HEAD version (head fan-out tier, ISSUE 17): the
+    catalog record of a head add/swap.  Weights themselves live on the
+    serving :class:`~sparkdl_tpu.parallel.engine.HeadBank` — the catalog
+    keeps the content digest, so "which bytes is tenant t serving?" is
+    answerable without holding a second copy of every head."""
+
+    __slots__ = ("name", "tenant", "version", "weights_digest", "label")
+
+    def __init__(self, name: str, tenant: str, version: int,
+                 weights_digest: Optional[str],
+                 label: Optional[str] = None):
+        self.name = name
+        self.tenant = tenant
+        self.version = int(version)
+        self.weights_digest = weights_digest
+        self.label = label
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "tenant": self.tenant,
+                "version": self.version,
+                "weights_digest": self.weights_digest,
+                "label": self.label}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        return (f"HeadVersion({self.name!r}, {self.tenant!r}, "
+                f"v{self.version})")
+
+
 class FleetEntry:
-    """A named model slot: the ONE resolved fn + its versions."""
+    """A named model slot: the ONE resolved fn + its versions (and, for
+    head fan-out entries, per-tenant head versions — the backbone fn and
+    its weights never version through those)."""
 
     __slots__ = ("name", "featurize", "fn", "default_variables",
                  "engine_overrides", "model_desc", "versions",
-                 "_next_version")
+                 "_next_version", "heads")
 
     def __init__(self, name: str, fn, default_variables: Any,
                  engine_overrides: Dict[str, Any], featurize: bool,
@@ -69,14 +100,20 @@ class FleetEntry:
         self.model_desc = model_desc
         self.versions: Dict[int, ModelVersion] = {}
         self._next_version = 1
+        #: tenant -> ordered head versions (head fan-out entries only)
+        self.heads: Dict[str, List[HeadVersion]] = {}
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "name": self.name,
             "model": self.model_desc,
             "featurize": self.featurize,
             "versions": sorted(self.versions),
         }
+        if self.heads:
+            out["heads"] = {t: hv[-1].version
+                            for t, hv in sorted(self.heads.items()) if hv}
+        return out
 
 
 class ModelRegistry:
@@ -162,6 +199,37 @@ class ModelRegistry:
         logger.info("registered %s v%d%s", name, v,
                     f" ({label})" if label else "")
         return mv
+
+    def register_head(self, name: str, tenant: str, weights: Any = None,
+                      *, label: Optional[str] = None) -> HeadVersion:
+        """Append tenant ``tenant``'s next HEAD version under entry
+        ``name`` (head fan-out tier).  Head versions are numbered
+        monotonically PER TENANT and carry only the weight digest — the
+        catalog half of ``Fleet.add_head``/``swap_head``.  The entry's
+        backbone fn and ModelVersion chain are untouched by design:
+        that is what makes head churn provably backbone-neutral."""
+        entry = self.entry(name)
+        tenant = str(tenant)
+        digest = None
+        if weights is not None:
+            from sparkdl_tpu.utils.digest import content_digest
+
+            digest = content_digest(weights)
+        with self._lock:
+            chain = entry.heads.setdefault(tenant, [])
+            hv = HeadVersion(name, tenant, len(chain) + 1, digest,
+                             label=label)
+            chain.append(hv)
+        logger.info("registered %s head %s v%d%s", name, tenant,
+                    hv.version, f" ({label})" if label else "")
+        return hv
+
+    def head_versions(self, name: str, tenant: str) -> List[int]:
+        """The registered head-version numbers for ``tenant`` (empty
+        for a tenant with no head history)."""
+        entry = self.entry(name)
+        with self._lock:
+            return [hv.version for hv in entry.heads.get(str(tenant), [])]
 
     def discard(self, name: str, version: int) -> None:
         """Back out a version that never deployed (the fleet's

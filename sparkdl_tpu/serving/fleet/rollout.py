@@ -213,3 +213,72 @@ class Rollout:
                 "requests": self._n,
                 "canary_requests": self._canary_n,
             }
+
+
+def head_swap_report(name: str, tenant: str, op: str,
+                     exec_before: Dict[int, Dict[str, Any]],
+                     exec_now: Dict[int, Dict[str, Any]],
+                     bank_before: Dict[str, Any],
+                     bank_now: Dict[str, Any],
+                     fingerprint_before: Any,
+                     fingerprint_now: Any) -> Dict[str, Any]:
+    """The head hot-swap analog of :meth:`Rollout.report` — THE proof
+    that a per-tenant head mutation can never recompile the backbone.
+
+    Three independent witnesses, all chip-free:
+
+    * per backbone bucket, the jit object after the swap is the SAME
+      object as before (``shared_jit`` — a head churn that re-jitted
+      the backbone would mint a new one), and the shared executable
+      cache did not grow (a same-shape backbone re-trace would);
+    * the head bank's fan-out jit object is likewise the same (a head
+      add may legitimately grow ITS executable cache — that is the
+      HEAD program re-lowering at a doubled capacity, reported but not
+      counted against the backbone);
+    * the backbone's committed StableHLO identity
+      (``serving.cache.lockfile_model_fingerprint``) is byte-equal
+      before and after, pinning "same computation" against
+      ``PROGRAMS.lock.json`` exactly like cache swap-survival does.
+
+    ``no_backbone_recompile`` is the conjunction — the bit the tests
+    and the fleet's swap reports assert."""
+    buckets: Dict[int, Dict[str, Any]] = {}
+    compared = False
+    reused = True
+    for b in sorted(set(exec_before) | set(exec_now)):
+        before = exec_before.get(b)
+        cur = exec_now.get(b)
+        shared = (before is not None and cur is not None
+                  and before["jit_id"] == cur["jit_id"])
+        buckets[b] = {
+            "shared_jit": shared,
+            "executables_before": (before or {}).get("executables"),
+            "executables_now": (cur or {}).get("executables"),
+        }
+        if before is not None and cur is not None:
+            compared = True
+            reused = reused and shared
+            eb = before.get("executables")
+            en = cur.get("executables")
+            if eb is not None and en is not None and en > eb:
+                reused = False  # backbone executable growth = recompile
+    fp_pinned = (fingerprint_before is not None
+                 and fingerprint_before == fingerprint_now)
+    return {
+        "name": name,
+        "tenant": tenant,
+        "op": op,
+        "buckets": buckets,
+        "head_jit_shared": bank_before.get("jit_id") == bank_now.get(
+            "jit_id"),
+        "head_executables_before": bank_before.get("executables"),
+        "head_executables_now": bank_now.get("executables"),
+        "bank_mode": bank_now.get("mode"),
+        "fingerprint_before": fingerprint_before,
+        "fingerprint_now": fingerprint_now,
+        "fingerprint_pinned": fp_pinned,
+        "no_backbone_recompile": bool(
+            compared and reused
+            and bank_before.get("jit_id") == bank_now.get("jit_id")
+            and (fingerprint_before is None or fp_pinned)),
+    }

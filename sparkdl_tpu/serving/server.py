@@ -1190,3 +1190,344 @@ class Server:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.close(drain=exc_type is None)
+
+
+class HeadFanoutServer:
+    """Featurize ONCE, serve thousands of per-tenant heads (ISSUE 17).
+
+    The production shape of the paper's core trick (a shared
+    ``DeepImageFeaturizer`` backbone + a cheap per-use-case head): one
+    backbone :class:`Server` at the FEATURE cut, fronted by the
+    feature-cut cache namespace (``serving.cache.feature_namespace`` —
+    keyed on the backbone's lockfile fingerprint + weight digest, so a
+    hot content digest pays the backbone once EVER, and head churn
+    keeps entries warm), fanned out through a
+    :class:`~sparkdl_tpu.parallel.engine.HeadBank` whose single vmapped
+    program serves every tenant's head by gather-by-tenant-index.
+
+    Per-request cost once the feature cache is warm: zero backbone
+    FLOPs, zero backbone queue slots (the probe short-circuits BEFORE
+    the backbone server), head-milliseconds only.  Per-fleet HBM cost:
+    one backbone copy + one stacked head bank (budgeted via
+    ``hbm_budget_bytes`` against ``mesh.param_sharding_stats``) instead
+    of a full model copy per tenant.
+
+    The no-backbone-recompile contract: :meth:`add_head` /
+    :meth:`swap_head` / :meth:`remove_head` return a
+    ``serving.fleet.rollout.head_swap_report`` proving — via backbone
+    jit-object identity, executable-cache non-growth, and the committed
+    ``PROGRAMS.lock.json`` fingerprint — that head mutation never
+    touched the backbone program.  In-flight requests are safe across a
+    swap: the bank mutates atomically under its lock, so every future
+    settles (with the old head's output or the new one, never a torn
+    bank)."""
+
+    def __init__(self, model, variables: Any = None, *,
+                 head_fn: Optional[Callable] = None,
+                 mesh=None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 cache: Any = None,
+                 metrics: Optional[Metrics] = None,
+                 model_desc: Optional[str] = None,
+                 **server_kwargs):
+        from sparkdl_tpu.parallel.engine import HeadBank
+        from sparkdl_tpu.serving.cache import (feature_namespace,
+                                               lockfile_model_fingerprint,
+                                               resolve_cache)
+
+        if isinstance(model, str):
+            from sparkdl_tpu.transformers.named_image import \
+                zoo_serving_bundle
+
+            fn, host_vars, overrides, zoo_head = zoo_serving_bundle(
+                model, featurize=True, feature_cut=True)
+            if head_fn is None:
+                head_fn = zoo_head
+            desc = model
+        else:
+            fn, host_vars, overrides = _resolve_model(
+                model, variables, featurize=True)
+            desc = getattr(model, "__name__", type(model).__name__)
+        self.model_desc = model_desc if model_desc is not None else desc
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._backbone_fn = fn
+        self._backbone_vars = host_vars
+        # Backbone identity, pinned ONCE at construction: the committed
+        # StableHLO fingerprint (None for unaudited fns) and the weight
+        # digest together key the feature-cut namespace — head churn
+        # can touch neither.
+        self._fingerprint = lockfile_model_fingerprint(self.model_desc)
+        self._weights_digest = content_digest(host_vars)
+        self._feature_ns = feature_namespace(
+            self.model_desc, self._fingerprint, self._weights_digest)
+        # The backbone Server is built from the RESOLVED fn (one
+        # resolution, like the fleet registry) so its jit identity is
+        # this object's identity for the whole lifetime; zoo engine
+        # overrides ride along fleet-style (caller kwargs win, and the
+        # dtype pair travels together).
+        dtype_keys = ("compute_dtype", "output_host_dtype")
+        caller_set_dtype = any(k in server_kwargs for k in dtype_keys)
+        for k, v in overrides.items():
+            if k in dtype_keys and caller_set_dtype:
+                continue
+            server_kwargs.setdefault(k, v)
+        resolved_cache, _, _ = resolve_cache(cache, self._feature_ns,
+                                             "headfanout")
+        self._backbone = Server(fn, host_vars, mesh=mesh,
+                                cache=(resolved_cache if resolved_cache
+                                       is not None else False),
+                                cache_namespace=self._feature_ns,
+                                metrics=self.metrics, **server_kwargs)
+        self._bank = HeadBank(head_fn=head_fn, mesh=mesh,
+                              hbm_budget_bytes=hbm_budget_bytes,
+                              metrics=self.metrics)
+        self.last_head_swap_report: Optional[Dict[str, Any]] = None
+        self._swap_lock = named_lock("serving.headfanout.swap")
+
+    # -- head management (the no-backbone-recompile surface) --------------
+
+    @property
+    def bank(self):
+        """The :class:`HeadBank` serving this tier's head pass."""
+        return self._bank
+
+    @property
+    def backbone(self) -> Server:
+        """The feature-cut backbone server."""
+        return self._backbone
+
+    @property
+    def feature_namespace(self) -> tuple:
+        """The feature-cut cache namespace (backbone identity only)."""
+        return self._feature_ns
+
+    def tenants(self) -> List[str]:
+        return self._bank.tenants()
+
+    def _head_mutation(self, op: str, tenant: str, weights) -> Dict[str, Any]:
+        from sparkdl_tpu.serving.fleet.rollout import head_swap_report
+
+        with self._swap_lock:
+            exec_before = self._backbone.executable_state()
+            bank_before = self._bank.jit_info()
+            fp_before = self._fingerprint
+            if op == "add":
+                self._bank.add_head(tenant, weights)
+            elif op == "swap":
+                self._bank.swap_head(tenant, weights)
+            else:
+                self._bank.remove_head(tenant)
+            from sparkdl_tpu.serving.cache import \
+                lockfile_model_fingerprint
+
+            report = head_swap_report(
+                self.model_desc, tenant, op,
+                exec_before, self._backbone.executable_state(),
+                bank_before, self._bank.jit_info(),
+                fp_before, lockfile_model_fingerprint(self.model_desc))
+            self.last_head_swap_report = report
+            return report
+
+    def add_head(self, tenant: str, weights) -> Dict[str, Any]:
+        """Register a new tenant's head; returns the no-backbone-
+        recompile report (``head_swap_report``)."""
+        return self._head_mutation("add", tenant, weights)
+
+    def swap_head(self, tenant: str, weights) -> Dict[str, Any]:
+        """Hot-swap an existing tenant's head under load; returns the
+        no-backbone-recompile report."""
+        return self._head_mutation("swap", tenant, weights)
+
+    def remove_head(self, tenant: str) -> Dict[str, Any]:
+        """Evict a departed tenant's head; returns the report."""
+        return self._head_mutation("remove", tenant, None)
+
+    # -- request path ------------------------------------------------------
+
+    def _feature_probe(self, example: Any):
+        """(digest-keyed feature row or None) from the feature-cut
+        cache — side-effect-free on a miss (``InferenceCache.get``), so
+        miss accounting stays with the backbone's single-flight
+        lookup."""
+        cache = self._backbone.cache
+        if cache is None:
+            return None
+        import jax
+
+        probe = example
+        if self._backbone._host_preprocess is not None:
+            probe = self._backbone._host_preprocess(probe)
+        probe = jax.tree_util.tree_map(np.asarray, probe)
+        key = self._feature_ns + (content_digest(probe),)
+        return cache.get(key)
+
+    def submit(self, example: Any, tenant: str,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one (example, tenant) request; returns a Future of the
+        tenant's head output row.
+
+        A warm content digest short-circuits BEFORE the backbone server
+        (``cache.feature_hit``): no backbone queue slot, no dispatch —
+        the request pays the head pass only.  A cold digest rides the
+        backbone's cached submit path (single-flight leaders, so N
+        concurrent identical payloads cost ONE backbone dispatch), and
+        the head pass runs when the features settle."""
+        tenant = str(tenant)
+        self.metrics.incr("headfanout.requests")
+        feats_value = self._feature_probe(example)
+        if feats_value is not None:
+            self.metrics.incr("headfanout.feature_hits")
+            flight_emit("cache.feature_hit", tenant=tenant)
+            out: Future = Future()
+            try:
+                row = self._bank.dispatch(
+                    np.asarray(feats_value)[None], [tenant])[0]
+            # graftlint: allow=SDL003 reason=the error is the future's result; the caller decides
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+            else:
+                out.set_result(row)
+            return out
+        feats_fut = self._backbone.submit(example, timeout_ms=timeout_ms)
+        out = Future()
+
+        def _features_done(f: Future) -> None:
+            try:
+                feats = f.result()
+                row = self._bank.dispatch(
+                    np.asarray(feats)[None], [tenant])[0]
+            # graftlint: allow=SDL003 reason=relayed to the caller's future; raising in a done-callback would only hit the executor's swallow
+            except BaseException as e:  # noqa: BLE001
+                if not out.done():
+                    out.set_exception(e)
+            else:
+                if not out.done():
+                    out.set_result(row)
+
+        feats_fut.add_done_callback(_features_done)
+        return out
+
+    def predict(self, example: Any, tenant: str,
+                timeout_ms: Optional[float] = None):
+        """Blocking single-request form of :meth:`submit`."""
+        return self.submit(example, tenant, timeout_ms=timeout_ms).result()
+
+    def predict_batch(self, examples: Sequence[Any],
+                      tenants: Sequence[str],
+                      timeout_ms: Optional[float] = None) -> List[Any]:
+        """K tenants' rows, ONE head pass: resolve every row's features
+        (warm digests from the cache, cold ones through the backbone —
+        which batches/coalesces them), stack, and dispatch the whole
+        mixed-tenant batch through the bank's single vmapped program."""
+        tenants = [str(t) for t in tenants]
+        if len(tenants) != len(examples):
+            raise ValueError(f"{len(examples)} examples but "
+                             f"{len(tenants)} tenants")
+        self.metrics.incr("headfanout.requests", len(tenants))
+        rows: List[Any] = [None] * len(tenants)
+        pending: List[tuple] = []
+        for i, ex in enumerate(examples):
+            feats = self._feature_probe(ex)
+            if feats is not None:
+                self.metrics.incr("headfanout.feature_hits")
+                flight_emit("cache.feature_hit", tenant=tenants[i])
+                rows[i] = np.asarray(feats)
+            else:
+                pending.append(
+                    (i, self._backbone.submit(ex, timeout_ms=timeout_ms)))
+        for i, fut in pending:
+            rows[i] = np.asarray(fut.result())
+        out = self._bank.dispatch(np.stack(rows), tenants)
+        self.metrics.incr("headfanout.head_passes")
+        return [out[i] for i in range(len(tenants))]
+
+    # -- proof / observability surfaces -----------------------------------
+
+    def executable_state(self) -> Dict[int, Dict[str, Any]]:
+        """The BACKBONE's per-bucket compiled-program identity (the
+        half the no-recompile proof pins; the head side is
+        :meth:`head_state`)."""
+        return self._backbone.executable_state()
+
+    def head_state(self) -> Dict[str, Any]:
+        """The head bank's jit identity + executable-cache size."""
+        return self._bank.jit_info()
+
+    def head_stats(self) -> Dict[str, Any]:
+        """Stacked-bank HBM accounting (``param_sharding_stats``)."""
+        return self._bank.stats()
+
+    def warmup(self, example: Any) -> None:
+        """Compile the backbone's bucket programs (no cache writes)."""
+        self._backbone.warmup(example)
+
+    def warm_head(self, features_row) -> None:
+        """Compile the head program for the current bank capacity by
+        dispatching one zeroed feature row — so latency measurements
+        over a sleep-wrapped backbone never charge a head compile."""
+        ts = self._bank.tenants()
+        if not ts:
+            return
+        row = np.zeros_like(np.asarray(features_row))
+        self._bank.dispatch(row[None], [ts[0]])
+
+    def health(self) -> Dict[str, Any]:
+        return self._backbone.health()
+
+    def queue_depth(self) -> int:
+        return self._backbone.queue_depth()
+
+    def queue_pressure(self) -> float:
+        return self._backbone.queue_pressure()
+
+    def breaker_retry_after(self) -> Optional[float]:
+        return self._backbone.breaker_retry_after()
+
+    def wake(self) -> None:
+        self._backbone.wake()
+
+    @property
+    def cache(self):
+        return self._backbone.cache
+
+    @property
+    def bucket_sizes(self) -> List[int]:
+        return self._backbone.bucket_sizes
+
+    def stats(self) -> Dict[str, float]:
+        summary = self.metrics.summary()
+        return {k: v for k, v in summary.items()
+                if k.startswith(("serving.", "engine_", "pipeline.",
+                                 "headfanout.", "headbank."))}
+
+    def varz(self) -> Dict[str, Any]:
+        """The backbone's ``/varz`` body plus the fan-out tier's own
+        section (bank mode/size/HBM, feature-hit counters, swap
+        report)."""
+        doc = self._backbone.varz()
+        snap = doc.get("metrics", {}).get("counters", {})
+        doc["headfanout"] = {
+            "tenants": len(self._bank),
+            "bank": self._bank.stats(),
+            "head_state": self._bank.jit_info(),
+            "feature_namespace": list(self._feature_ns),
+            "requests": snap.get("headfanout.requests", 0),
+            "feature_hits": snap.get("headfanout.feature_hits", 0),
+            "head_passes": snap.get("headfanout.head_passes", 0),
+            "last_head_swap_report": self.last_head_swap_report,
+        }
+        return doc
+
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = 30.0) -> None:
+        """Close the backbone server.  Feature entries are NOT
+        reclaimed: the namespace is backbone identity, not this
+        object's — a later server over the same backbone (same
+        fingerprint + weights) legitimately serves them warm."""
+        self._backbone.close(drain=drain, timeout_s=timeout_s)
+
+    def __enter__(self) -> "HeadFanoutServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)

@@ -97,7 +97,8 @@ def zoo_model_fn(name: str, featurize: bool, compute_dtype=None,
     return fn
 
 
-def zoo_serving_bundle(name: str, featurize: bool):
+def zoo_serving_bundle(name: str, featurize: bool,
+                       feature_cut: bool = False):
     """``(fn, variables, engine_overrides)`` for serving zoo model
     ``name`` — THE zoo resolution the online stack shares: weights via
     the process cache, the fn through :func:`zoo_model_fn` (so served ==
@@ -107,7 +108,18 @@ def zoo_serving_bundle(name: str, featurize: bool):
     ``serving.server._resolve_model`` and the fleet model registry
     (``serving.fleet.registry``); the registry resolves ONCE per entry
     and reuses the fn across versions, which is what lets a hot-swapped
-    version reuse the compiled executable instead of re-jitting."""
+    version reuse the compiled executable instead of re-jitting.
+
+    ``feature_cut=True`` (head fan-out tier, ISSUE 17) instead returns
+    the SPLIT bundle ``(backbone_fn, variables, engine_overrides,
+    head_fn)``: ``backbone_fn`` is the featurizer-cut fn — the exact
+    object the featurize programs in ``PROGRAMS.lock.json`` pin, built
+    through the same :func:`zoo_model_fn` path, so backbone identity
+    (jit object + StableHLO fingerprint) can NEVER change when tenant
+    heads churn — and ``head_fn`` is the canonical per-row head
+    (``parallel.engine.dense_head_row``) the vmapped
+    ``build_head_fanout_jit`` program serves over a
+    :class:`~sparkdl_tpu.parallel.engine.HeadBank`."""
     module, zoo_vars = _cached_model(name)
     cdt = None
     # GC001's recorded zoo exemption, enforced where the engines are
@@ -133,8 +145,17 @@ def zoo_serving_bundle(name: str, featurize: bool):
         cdt = jnp.bfloat16
         overrides.update({"compute_dtype": jnp.bfloat16,
                           "output_host_dtype": np.float32})
+    if feature_cut and not featurize:
+        raise ValueError(
+            "feature_cut=True requires featurize=True: the split's "
+            "backbone program IS the featurizer cut (the head fan-out "
+            "tier has no predictor-cut backbone)")
     fn = zoo_model_fn(name, featurize=featurize, compute_dtype=cdt,
                       module=module)
+    if feature_cut:
+        from sparkdl_tpu.parallel.engine import dense_head_row
+
+        return fn, zoo_vars, overrides, dense_head_row
     return fn, zoo_vars, overrides
 
 

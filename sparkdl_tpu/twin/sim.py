@@ -167,6 +167,15 @@ class TrafficTwin:
         slo = SLO("fleet-availability", "availability",
                   good="fleet.completed", total="fleet.requests",
                   objective=0.999)
+        # The twin's mesh pin is LOAD-BEARING beyond this file: a head
+        # fan-out entry deployed under the twin hands this same mesh to
+        # its HeadBank, whose stacked weights are replicated per device
+        # — on the 1-device pin the bank costs exactly one copy of HBM
+        # and the engine's jit cache keys (id(fn), mesh devices) stay
+        # stable across ticks.  Assert the pin rather than trust it.
+        twin_mesh = get_mesh(num_devices=1)
+        assert len(twin_mesh.devices.flat) == 1, (
+            "twin harness requires the single-device mesh pin")
         fleet = Fleet(
             default_quota=self.default_quota,
             # the stream tenant is infrastructure, not a customer: no
@@ -186,7 +195,7 @@ class TrafficTwin:
             # control, not data parallelism — and concurrent multi-
             # model batches over a shared virtual-device mesh would
             # contend on the same collective rendezvous
-            mesh=get_mesh(num_devices=1),
+            mesh=twin_mesh,
         )
         for i, name in enumerate(c.traffic_models):
             fleet.add_model(name, _model_fn, self._variables(31 + i))
