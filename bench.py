@@ -333,6 +333,17 @@ def emit(config, metric, value, unit, baseline_model=None, env_bound=None,
         shard = _sharding_rider(rec.get("metrics_snapshot"))
         if shard is not None:
             rec["sharding"] = shard
+    # the cost rider (ISSUE 18), next to the riders above: per-tenant
+    # spend breakdown + the regression sentinel's verdict from the
+    # process-default CostLedger (SPARKDL_COST gate — absent when cost
+    # attribution is off; extra wins for subprocess configs whose
+    # ledger lived in the child).
+    if "cost" not in rec:
+        from sparkdl_tpu.obs.cost import cost_rider, get_default
+
+        cost = cost_rider(get_default())
+        if cost is not None:
+            rec["cost"] = cost
     ta = _CONFIG_OBS.get("trace_artifact")
     if ta is not None and "trace_artifact" not in rec:
         rec["trace_artifact"] = ta

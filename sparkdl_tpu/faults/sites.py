@@ -76,6 +76,9 @@ SITE_HELP = {
     "probe.device": "__graft_entry__ device-count relay probe",
     "bench.relay_probe": "bench.py relay profile probe",
     "io.decode": "host image decode, per row",
+    "cost.attr": ("cost-ledger attribution of a settled batch or cache "
+                  "hit (observability: callers degrade to an error "
+                  "counter, a ledger failure never fails the request)"),
 }
 
 #: Registered injection sites, in layer order (the tuple every public

@@ -23,6 +23,15 @@ spans, and every run can export a machine-readable record.
   burn-rate math over the existing ``Metrics`` series, feeding
   ``HealthTracker`` degradation and surfacing in
   ``Server.varz()``/``Fleet.varz()``/``StreamScorer.health()``.
+* :mod:`~sparkdl_tpu.obs.cost` — the :class:`CostLedger` hardware
+  showback layer (``SPARKDL_COST`` gate): every settled request
+  attributed to a bounded (tenant, model, program, bucket) ledger —
+  metered device seconds split by real rows with the pad tax on a
+  shared ``__pad__`` line, batcher queue wait, lockfile-analytic
+  FLOPs, HBM byte-seconds, near-zero cache/coalesced/feature-hit
+  charges — plus the per-program perf-regression sentinel
+  (``cost.regression``/``cost.recovered`` flight events, SLO-style
+  ``health()`` degradation) and ``tools/costreport.py`` showback.
 
 Instrumented surfaces: ``serving.Server``/``DynamicBatcher`` (request +
 micro-batch spans; shed/drain flight events; ``batch.topoff`` events +
@@ -41,8 +50,12 @@ evict/invalidate flight events + ``cache.*`` metrics),
 ``stream.chunk`` spans + stall/redelivery/commit flight events),
 ``utils.health.HealthTracker`` (ready<->degraded transition events),
 ``faults`` (``fault.fired`` per injected rule firing), ``utils.retry``
-(``retry.attempt`` per re-execution), and ``bench.py`` (one trace
-artifact + metrics snapshot + ``slo`` snapshot per config line).
+(``retry.attempt`` per re-execution), ``obs.cost.CostLedger``
+(per-tenant/per-program attribution in ``varz()["cost"]``; its own
+labeled ``prometheus_text``; ``cost.regression``/``cost.recovered``
+flight events from the sentinel; the ``cost.attr`` degrade-not-fail
+fault site), and ``bench.py`` (one trace artifact + metrics snapshot +
+``slo`` + ``cost`` snapshot per config line).
 """
 
 from sparkdl_tpu.obs.exemplar import ExemplarReservoir
@@ -55,6 +68,9 @@ from sparkdl_tpu.obs.trace import (NULL_SPAN, Span, Tracer, configure,
                                    get_tracer, tracing_from_env)
 from sparkdl_tpu.obs import flight
 from sparkdl_tpu.obs import slo as slo_module  # noqa: F401 — re-export
+from sparkdl_tpu.obs import cost as cost_module  # noqa: F401 — re-export
+from sparkdl_tpu.obs.cost import (CostLedger, CostRegression, cost_rider,
+                                  resolve_cost)
 from sparkdl_tpu.obs.flight import FlightRecorder, blackbox_from_env
 from sparkdl_tpu.obs.slo import SLO, SLOEngine, SLOViolation, slo_snapshot
 
@@ -82,4 +98,8 @@ __all__ = [
     "SLOEngine",
     "SLOViolation",
     "slo_snapshot",
+    "CostLedger",
+    "CostRegression",
+    "cost_rider",
+    "resolve_cost",
 ]

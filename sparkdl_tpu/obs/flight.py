@@ -143,6 +143,12 @@ EVENT_HELP = {
     "retry.attempt": "a transient failure is about to be re-executed",
     "slo.breach": "an SLO's burn rate crossed its threshold",
     "slo.recovered": "a breaching SLO's burn rate dropped back under",
+    "cost.regression": ("a program's rolling device-time/row crossed "
+                        "the cost sentinel's baseline or lockfile-"
+                        "analytic threshold (attrs carry the program, "
+                        "factor and measured/baseline us-per-row)"),
+    "cost.recovered": ("a regressed program's device-time/row dropped "
+                       "back under the recovery threshold"),
 }
 
 #: Registered event names, in layer order (derived from EVENT_HELP so

@@ -1125,9 +1125,16 @@ class InferenceEngine:
 
     # -- whole-array API ---------------------------------------------------
     def __call__(self, batch, window: int = 2,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 on_metered=None):
         """Process a full batch (array or pytree); returns host output with
         matching row count.
+
+        ``on_metered``, when given, is invoked once per call with the
+        metered wall seconds (the same span ``engine_call`` records) —
+        the cost ledger's device-time feed.  Per-call rather than
+        per-engine so concurrent batches on one shared bucket engine
+        each observe their own span.
 
         Host-memory contract: the pipelined path (``pipeline=True``, the
         ``SPARKDL_PIPELINE`` default) PREALLOCATES the output — the leaf
@@ -1188,6 +1195,12 @@ class InferenceEngine:
         elapsed = time.perf_counter() - t0
         self.metrics.incr("items", n)
         self.metrics.record_time("engine_call", elapsed)
+        # unbounded float accumulator (timing series are capped): THE
+        # conservation reference the cost ledger's totals are proved
+        # against
+        self.metrics.incr("engine.device_time_s", elapsed)
+        if on_metered is not None:
+            on_metered(elapsed)
         return result
 
     def _stack_group(self, pieces):

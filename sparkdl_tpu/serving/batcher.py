@@ -68,11 +68,14 @@ class Request:
     """
 
     __slots__ = ("payload", "future", "enqueued_at", "deadline", "span",
-                 "batch_span")
+                 "batch_span", "tenant")
 
     def __init__(self, payload: Any, deadline: Optional[float] = None,
-                 now: Optional[float] = None):
+                 now: Optional[float] = None, tenant: str = "default"):
         self.payload = payload
+        # cost-attribution identity only (admission/quota live in the
+        # Fleet): every request charges SOME tenant, anonymous = "default"
+        self.tenant = tenant
         self.future: Future = Future()
         # ``now`` lets a clock-injected caller stamp queue entry on the
         # same (possibly virtual) timeline its deadlines live on

@@ -408,20 +408,30 @@ class InferenceCache:
 
     def info(self) -> Dict[str, Any]:
         """JSON-serializable snapshot (the ``cache`` section of
-        ``Server.varz()``/``Fleet.varz()`` and the bench line rider)."""
+        ``Server.varz()``/``Fleet.varz()`` and the bench line rider).
+
+        ``counters`` always carries the feature-cut keys
+        (``cache.feature_hits``/``cache.feature_requests``, zero when
+        the deployment has no fan-out tier): ``HeadFanoutServer.varz()``
+        merges its tier's counts over them, so BOTH server types expose
+        the cache section under one schema and a dashboard query never
+        branches on server type (ISSUE 18 satellite)."""
         with self._lock:
             entries = len(self._data)
             total = self._bytes
             inflight = len(self._flights)
+        counters = {"cache.feature_hits": 0, "cache.feature_requests": 0}
+        counters.update(
+            {k: v for k, v in
+             self.metrics.snapshot_raw()["counters"].items()
+             if k.startswith("cache.")})
         return {
             "entries": entries,
             "bytes": total,
             "max_entries": self.max_entries,
             "max_bytes": self.max_bytes,
             "inflight_leaders": inflight,
-            "counters": {k: v for k, v in
-                         self.metrics.snapshot_raw()["counters"].items()
-                         if k.startswith("cache.")},
+            "counters": counters,
         }
 
     @staticmethod

@@ -83,6 +83,7 @@ class Fleet:
                  shed_pressure: Optional[Dict[int, float]] = None,
                  slos: Optional[List[Any]] = None,
                  cache: Any = None,
+                 cost: Any = None,
                  program_fingerprints: Any = None,
                  metrics: Optional[Metrics] = None,
                  clock: Optional[Callable[[], float]] = None,
@@ -131,6 +132,16 @@ class Fleet:
         # snapshot is the last_error/transitions half of the unified
         # health() payload.
         self._health = HealthTracker("fleet.health")
+        # ONE cost ledger for the whole fleet (ISSUE 18): every server
+        # this fleet builds charges the same instance, so showback and
+        # the regression sentinel see the fleet-wide picture.  Bound to
+        # the FLEET tracker (first-binder-wins), so an open cost
+        # regression degrades fleet health() like an SLO breach.
+        from sparkdl_tpu.obs.cost import resolve_cost
+
+        self._cost = resolve_cost(cost)
+        if self._cost is not None:
+            self._cost.bind_health(self._health)
         self._slo_engine = None
         if slos:
             from sparkdl_tpu.obs.slo import SLOEngine
@@ -260,6 +271,11 @@ class Fleet:
         kw.update(server_kwargs)
         kw.setdefault("cache",
                       self._cache if self._cache is not None else False)
+        # fleet-shared ledger (False, not None: the fleet resolved the
+        # SPARKDL_COST default once — per-entry servers must not
+        # re-resolve it behind its back)
+        kw.setdefault("cost",
+                      self._cost if self._cost is not None else False)
         server = None
         try:
             server = HeadFanoutServer(
@@ -374,6 +390,14 @@ class Fleet:
                 # per-version servers must not re-resolve it behind
                 # its back
                 kw["cache"] = False
+        kw.setdefault("cost",
+                      self._cost if self._cost is not None else False)
+        # zoo entries keep the lockfile-facing model name so the cost
+        # ledger's FLOPs lookup lands on the committed dispatch records
+        # (tolerate registry doubles that carry no model_desc)
+        md = getattr(entry, "model_desc", None)
+        if md is not None:
+            kw.setdefault("model_desc", md)
         return Server(entry.fn, variables=mv.variables, **kw)
 
     def _resolve_fingerprint(self, entry) -> Optional[str]:
@@ -629,7 +653,8 @@ class Fleet:
                         fut = server.submit(example, tenant,
                                             timeout_ms=timeout_ms)
                     else:
-                        fut = server.submit(example, timeout_ms=timeout_ms)
+                        fut = server.submit(example, timeout_ms=timeout_ms,
+                                            tenant=tenant)
                 break
             except ServerClosedError:
                 span.finish("rejected")
@@ -830,10 +855,18 @@ class Fleet:
             "health": self.health(),
             "admission": self.admission.snapshot(),
             "tenants": per_tenant,
+            "cost": (self._cost.snapshot() if self._cost is not None
+                     else None),
             "counters": {k: v for k, v in snap["counters"].items()
                          if k.startswith("fleet.")},
             "metrics": snap,
         }
+
+    @property
+    def cost(self):
+        """The fleet-shared :class:`~sparkdl_tpu.obs.cost.CostLedger`
+        (None when cost attribution is off)."""
+        return self._cost
 
     # -- lifecycle ---------------------------------------------------------
     @property

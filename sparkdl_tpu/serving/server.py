@@ -23,6 +23,7 @@ Production envelope:
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -306,9 +307,18 @@ class Server:
                  partition_rules: Any = None,
                  param_shardings: Any = None,
                  metrics: Optional[Metrics] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 cost: Any = None,
+                 model_desc: Optional[str] = None):
         self._fn, self._host_variables, _overrides = _resolve_model(
             model, variables, featurize)
+        # what the cost ledger's lockfile lookup and showback report as
+        # "model": zoo names match PROGRAMS.lock.json dispatch records;
+        # anything else gets the fn's name (rows-only attribution)
+        self.model_desc = (model_desc if model_desc is not None
+                           else (model if isinstance(model, str)
+                                 else getattr(model, "__name__",
+                                              type(model).__name__)))
         if compute_dtype is None and output_host_dtype is None:
             compute_dtype = _overrides.get("compute_dtype")
             output_host_dtype = _overrides.get("output_host_dtype")
@@ -369,6 +379,18 @@ class Server:
         # point-in-time poll would race past.  Shared with the streaming
         # runner since ISSUE 8 (utils.health mirrors this contract).
         self._health = HealthTracker("serving.health")
+        # Hardware cost attribution (ISSUE 18): ``cost=None`` resolves
+        # the SPARKDL_COST process default (unset env = unmetered),
+        # ``cost=False`` forces unmetered, a CostLedger is shared (the
+        # fleet passes one across its servers).  First health binder
+        # wins: a fleet binds its fleet-wide tracker before handing the
+        # ledger here, so this bind is a no-op in that deployment.
+        from sparkdl_tpu.obs.cost import resolve_cost
+
+        self._cost = resolve_cost(cost)
+        if self._cost is not None:
+            self._cost.bind_health(self._health)
+        self._cost_hbm: Dict[int, float] = {}
         # Declarative objectives (ISSUE 9): evaluated over THIS server's
         # metrics on every health()/varz() poll; a burn-rate breach
         # degrades the same tracker dispatch failures do, so "degraded"
@@ -618,8 +640,14 @@ class Server:
 
     # -- request path ------------------------------------------------------
     def submit(self, example: Any,
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Admit one example; returns its ``concurrent.futures.Future``.
+
+        ``tenant`` is the cost-attribution identity (ISSUE 18) — it
+        changes nothing about scheduling or admission here (quota lives
+        in the Fleet); it only decides which ledger line the request's
+        device/queue time lands on.  None charges ``"default"``.
 
         Raises ``ServerClosedError`` after close, ``QueueFullError``
         (with ``retry_after_s``) under backpressure, and
@@ -640,11 +668,29 @@ class Server:
         if self._closed:
             raise ServerClosedError("server is closed")
         if self._cache is not None:
-            return self._submit_cached(example, timeout_ms)
-        return self._submit_dispatch(example, timeout_ms)
+            return self._submit_cached(example, timeout_ms, tenant)
+        return self._submit_dispatch(example, timeout_ms, tenant=tenant)
+
+    def _charge_hit(self, tenant: Optional[str], kind: str) -> None:
+        """Near-zero ledger charge for a cache-absorbed request.
+        Attribution is observability: any failure (the ``cost.attr``
+        fault site included) degrades to an error counter — it must
+        never fail the request it was accounting for."""
+        if self._cost is None:
+            return
+        try:
+            self._cost.record_hit(tenant=tenant or "default",
+                                  model=self.model_desc, kind=kind)
+        # graftlint: allow=SDL003 reason=cost.attr degrade contract: attribution failure is counted and logged, the request it accounted for already served
+        except Exception as e:  # noqa: BLE001
+            self.metrics.incr("serving.cost_attr_errors")
+            self._cost.record_error()
+            logger.warning("cost attribution (%s) failed: %s: %s", kind,
+                           type(e).__name__, e)
 
     def _submit_cached(self, example: Any,
-                       timeout_ms: Optional[float]) -> Future:
+                       timeout_ms: Optional[float],
+                       tenant: Optional[str] = None) -> Future:
         """The cache-fronted request path; see :meth:`submit`."""
         import jax
 
@@ -660,12 +706,14 @@ class Server:
             self.metrics.incr("serving.cache_hits")
             self.metrics.record_time("serving.request_latency",
                                      self._clock() - t0)
+            self._charge_hit(tenant, "hit")
             fut: Future = Future()
             fut.set_result(res)
             return fut
         if kind == "follower":
             self.metrics.incr("serving.requests")
             self.metrics.incr("serving.cache_coalesced")
+            self._charge_hit(tenant, "coalesced")
 
             def _follower_done(f: Future) -> None:
                 if not f.cancelled() and f.exception() is None:
@@ -705,7 +753,7 @@ class Server:
             # failure every follower must see (and caches nothing)
             inject("cache.stampede")
             fut = self._submit_dispatch(example, timeout_ms,
-                                        preprocessed=True)
+                                        preprocessed=True, tenant=tenant)
         except BaseException as e:  # noqa: BLE001 — settled to followers, re-raised
             self._cache.fail(flight, e)
             raise
@@ -744,7 +792,8 @@ class Server:
 
     def _submit_dispatch(self, example: Any,
                          timeout_ms: Optional[float],
-                         preprocessed: bool = False) -> Future:
+                         preprocessed: bool = False,
+                         tenant: Optional[str] = None) -> Future:
         """The direct dispatch path (the whole request path when no
         cache is configured; the single-flight leader's path when one
         is)."""
@@ -771,7 +820,8 @@ class Server:
                      else max(0.0, timeout_ms) / 1e3)
         now_m = self._clock()
         deadline = None if timeout_s is None else now_m + timeout_s
-        req = Request(example, deadline, now=now_m)
+        req = Request(example, deadline, now=now_m,
+                      tenant=tenant or "default")
         tracer = get_tracer()
         if tracer.enabled:
             # root span of this request's trace: submit -> future settle
@@ -845,8 +895,33 @@ class Server:
         finally:
             finish()
 
+    @staticmethod
+    def _metered_kwargs(eng, on_metered) -> Dict[str, Any]:
+        """``{"on_metered": ...}`` only when ``eng`` can take it.  Tests
+        (and embedders) substitute plain ``fn(batch)`` callables for the
+        engine; those still serve — they just don't feed the cost
+        ledger's device-time meter (they don't tick the engine's
+        ``engine.device_time_s`` counter either, so conservation holds).
+        The signature probe is cached on the callable."""
+        if on_metered is None:
+            return {}
+        cached = getattr(eng, "_sdl_accepts_on_metered", None)
+        if cached is None:
+            try:
+                params = inspect.signature(eng).parameters
+                cached = ("on_metered" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()))
+            except (TypeError, ValueError):
+                cached = False
+            try:
+                eng._sdl_accepts_on_metered = cached
+            except AttributeError:
+                pass
+        return {"on_metered": on_metered} if cached else {}
+
     def _guarded_call(self, eng, stacked, requests: List[Request],
-                      finish: _Once):
+                      finish: _Once, on_metered=None):
         """One model-call ATTEMPT under the stall watchdog.  The timer is
         armed per attempt (retry backoff and later attempts get their own
         window, so configuring retries never silently nullifies them) and
@@ -856,9 +931,10 @@ class Server:
         sits INSIDE the watchdog window (a ``sleep`` rule is a wedged
         model the watchdog must catch; an ``error`` rule is a per-batch
         model failure)."""
+        meter_kw = self._metered_kwargs(eng, on_metered)
         if self._dispatch_timeout_s is None:
             inject("serving.model")
-            return eng(stacked)
+            return eng(stacked, **meter_kw)
         attempt_done = threading.Event()
 
         def on_stall():
@@ -879,7 +955,7 @@ class Server:
         timer.start()
         try:
             inject("serving.model")
-            return eng(stacked)
+            return eng(stacked, **meter_kw)
         finally:
             attempt_done.set()
             timer.cancel()
@@ -932,9 +1008,11 @@ class Server:
                 requests.extend(extras)
                 n = len(requests)
         now = self._clock()
+        queue_by: Dict[str, float] = {}
         for r in requests:
-            self.metrics.record_time("serving.time_in_queue",
-                                     now - r.enqueued_at)
+            waited = now - r.enqueued_at
+            self.metrics.record_time("serving.time_in_queue", waited)
+            queue_by[r.tenant] = queue_by.get(r.tenant, 0.0) + waited
         # Dispatch rides the same engine entrypoint as the offline stack
         # (parallel.pipeline): a micro-batch is a single device batch, so
         # the engine's single-piece fast path applies (no thread hop on
@@ -959,6 +1037,10 @@ class Server:
         # re-root this worker thread onto the micro-batch span so the
         # engine's own spans (engine.call -> engine.dispatch) nest under
         # serving.request -> serving.microbatch
+        # per-attempt metered engine seconds (the cost ledger's device-
+        # time feed; retries append — the batch is charged what it
+        # actually burned, not just the winning attempt)
+        metered: List[float] = []
         with tracer.use(batch_span):
             # CircuitOpenError is exempt from the batch retry budget for
             # the same reason the engine's own _run_dispatch exempts it:
@@ -967,7 +1049,8 @@ class Server:
             # into seconds of dead sleep against a device known to be
             # failing
             out = with_retries(
-                lambda: self._guarded_call(eng, stacked, requests, finish),
+                lambda: self._guarded_call(eng, stacked, requests, finish,
+                                           on_metered=metered.append),
                 max_retries=self._max_retries,
                 non_retryable=NON_RETRYABLE + (CircuitOpenError,),
                 backoff_seconds=self._retry_backoff_s)
@@ -978,6 +1061,36 @@ class Server:
         self.metrics.record_time("serving.batch_latency", batch_s)
         self.metrics.observe("serving.batch_fill_ratio",
                              n / eng.device_batch_size)
+        # Attribute the settled batch BEFORE futures resolve, so any
+        # completion-ordered observer (the fleet's settle barrier, the
+        # twin's tick) sees the ledger already charged.  Degrade-not-
+        # fail: the batch SERVED — an attribution failure (cost.attr
+        # chaos included) is an error counter, never a failed request.
+        if self._cost is not None:
+            try:
+                tenant_rows: Dict[str, int] = {}
+                for r in requests:
+                    tenant_rows[r.tenant] = tenant_rows.get(r.tenant,
+                                                            0) + 1
+                hbm = self._cost_hbm.get(bucket)
+                if hbm is None:
+                    sh = eng.sharding_info()
+                    hbm = float(sh.get("param_bytes_per_chip") or 0.0)
+                    self._cost_hbm[bucket] = hbm
+                self._cost.record_batch(
+                    model=self.model_desc, bucket=bucket,
+                    tenant_rows=tenant_rows,
+                    device_s=sum(metered),
+                    queue_s_by_tenant=queue_by,
+                    pad_rows=bucket - n,
+                    hbm_bytes=hbm)
+            # graftlint: allow=SDL003 reason=cost.attr degrade contract: attribution failure is counted and logged, the served batch still settles below
+            except Exception as e:  # noqa: BLE001
+                self.metrics.incr("serving.cost_attr_errors")
+                self._cost.record_error()
+                logger.warning("cost attribution failed for batch of %d "
+                               "(bucket %d): %s: %s", n, bucket,
+                               type(e).__name__, e)
         done = self._clock()
         slowest: Optional[Request] = None
         slowest_s = 0.0
@@ -1131,6 +1244,8 @@ class Server:
             "metrics": snap,
             "cache": (self._cache.info() if self._cache is not None
                       else None),
+            "cost": (self._cost.snapshot() if self._cost is not None
+                     else None),
             "sharding": self.sharding_info(),
             "exemplars": self.exemplars.snapshot(),
         }
@@ -1227,6 +1342,7 @@ class HeadFanoutServer:
                  mesh=None,
                  hbm_budget_bytes: Optional[int] = None,
                  cache: Any = None,
+                 cost: Any = None,
                  metrics: Optional[Metrics] = None,
                  model_desc: Optional[str] = None,
                  **server_kwargs):
@@ -1273,11 +1389,21 @@ class HeadFanoutServer:
             server_kwargs.setdefault(k, v)
         resolved_cache, _, _ = resolve_cache(cache, self._feature_ns,
                                              "headfanout")
+        # One ledger for the tier: feature-hit charges here and the
+        # backbone's device-time attribution land on the SAME instance,
+        # so the per-tenant showback covers both halves of a request
+        from sparkdl_tpu.obs.cost import resolve_cost
+
+        self._cost = resolve_cost(cost)
         self._backbone = Server(fn, host_vars, mesh=mesh,
                                 cache=(resolved_cache if resolved_cache
                                        is not None else False),
                                 cache_namespace=self._feature_ns,
-                                metrics=self.metrics, **server_kwargs)
+                                metrics=self.metrics,
+                                cost=(self._cost if self._cost is not None
+                                      else False),
+                                model_desc=self.model_desc,
+                                **server_kwargs)
         self._bank = HeadBank(head_fn=head_fn, mesh=mesh,
                               hbm_budget_bytes=hbm_budget_bytes,
                               metrics=self.metrics)
@@ -1378,6 +1504,7 @@ class HeadFanoutServer:
         if feats_value is not None:
             self.metrics.incr("headfanout.feature_hits")
             flight_emit("cache.feature_hit", tenant=tenant)
+            self._charge_feature_hit(tenant)
             out: Future = Future()
             try:
                 row = self._bank.dispatch(
@@ -1388,7 +1515,8 @@ class HeadFanoutServer:
             else:
                 out.set_result(row)
             return out
-        feats_fut = self._backbone.submit(example, timeout_ms=timeout_ms)
+        feats_fut = self._backbone.submit(example, timeout_ms=timeout_ms,
+                                          tenant=tenant)
         out = Future()
 
         def _features_done(f: Future) -> None:
@@ -1431,10 +1559,12 @@ class HeadFanoutServer:
             if feats is not None:
                 self.metrics.incr("headfanout.feature_hits")
                 flight_emit("cache.feature_hit", tenant=tenants[i])
+                self._charge_feature_hit(tenants[i])
                 rows[i] = np.asarray(feats)
             else:
                 pending.append(
-                    (i, self._backbone.submit(ex, timeout_ms=timeout_ms)))
+                    (i, self._backbone.submit(ex, timeout_ms=timeout_ms,
+                                              tenant=tenants[i])))
         for i, fut in pending:
             rows[i] = np.asarray(fut.result())
         out = self._bank.dispatch(np.stack(rows), tenants)
@@ -1500,10 +1630,31 @@ class HeadFanoutServer:
                 if k.startswith(("serving.", "engine_", "pipeline.",
                                  "headfanout.", "headbank."))}
 
+    def _charge_feature_hit(self, tenant: str) -> None:
+        """Near-zero ledger charge for a feature-cut short-circuit
+        (same degrade-not-fail contract as ``Server._charge_hit``)."""
+        if self._cost is None:
+            return
+        try:
+            self._cost.record_hit(tenant=tenant, model=self.model_desc,
+                                  kind="feature_hit")
+        # graftlint: allow=SDL003 reason=cost.attr degrade contract: attribution failure is counted and logged, the hit already served
+        except Exception as e:  # noqa: BLE001
+            self.metrics.incr("serving.cost_attr_errors")
+            self._cost.record_error()
+            logger.warning("cost attribution (feature_hit) failed: "
+                           "%s: %s", type(e).__name__, e)
+
     def varz(self) -> Dict[str, Any]:
         """The backbone's ``/varz`` body plus the fan-out tier's own
         section (bank mode/size/HBM, feature-hit counters, swap
-        report)."""
+        report).
+
+        The ``cache`` section follows the SAME schema as
+        ``Server.varz()`` — the fan-out tier's feature-cut hit and
+        request counters are merged into ``cache["counters"]`` under
+        ``cache.*`` keys, so one dashboard query shape covers both
+        server types (ISSUE 18 satellite)."""
         doc = self._backbone.varz()
         snap = doc.get("metrics", {}).get("counters", {})
         doc["headfanout"] = {
@@ -1516,6 +1667,14 @@ class HeadFanoutServer:
             "head_passes": snap.get("headfanout.head_passes", 0),
             "last_head_swap_report": self.last_head_swap_report,
         }
+        if doc.get("cache") is not None:
+            counters = doc["cache"].setdefault("counters", {})
+            counters["cache.feature_hits"] = snap.get(
+                "headfanout.feature_hits", 0)
+            counters["cache.feature_requests"] = snap.get(
+                "headfanout.requests", 0)
+        if self._cost is not None:
+            doc["cost"] = self._cost.snapshot()
         return doc
 
     def close(self, drain: bool = True,

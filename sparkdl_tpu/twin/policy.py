@@ -59,6 +59,13 @@ class TickObservation:
     canary_active: bool = False
     canary_fraction: float = 0.0
     flush_sizes: Dict[int, int] = field(default_factory=dict)
+    #: cumulative per-tenant measured cost (ISSUE 18): deterministic
+    #: cost units — completed rows weighted by the lockfile's analytic
+    #: FLOPs where the program is covered, plain rows otherwise — so
+    #: fairness is scored on what tenants actually burned, not on
+    #: request counts, while the byte-compared event stream stays free
+    #: of wall-clock values (the determinism contract above)
+    cost_by_tenant: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -126,7 +133,8 @@ class QuotaAutoscaler(Policy):
                  step: float = 2.0, max_scale: float = 8.0,
                  burn_trigger: float = 14.4,
                  deadline_stretch: float = 1.5,
-                 canary_step: float = 0.25):
+                 canary_step: float = 0.25,
+                 cost_share_cap: Optional[float] = None):
         super().__init__(deadline_ms=deadline_ms)
         if base_quota.rate_per_s is None:
             raise ValueError("QuotaAutoscaler needs a rate-limited "
@@ -137,6 +145,13 @@ class QuotaAutoscaler(Policy):
         self.burn_trigger = float(burn_trigger)
         self.deadline_stretch = float(deadline_stretch)
         self.canary_step = float(canary_step)
+        # cost-aware grants (ISSUE 18): a tenant already holding more
+        # than this share of the fleet's MEASURED cost
+        # (obs.cost_by_tenant) is denied quota scale-ups — shed-count
+        # pressure alone must not let the biggest spender crowd the
+        # grant loop.  None (default) preserves the pre-cost law.
+        self.cost_share_cap = (None if cost_share_cap is None
+                               else float(cost_share_cap))
         self._base_deadline_ms = self.deadline_ms
         self._scale: Dict[str, float] = {}
         self._promoted = False
@@ -161,7 +176,17 @@ class QuotaAutoscaler(Policy):
         quota_sheds = {t: n for t, n in sorted(obs.shed_by_tenant.items())
                        if n > 0}
         if burning and quota_sheds:
+            total_cost = sum(obs.cost_by_tenant.values())
             for t in quota_sheds:
+                if (self.cost_share_cap is not None and total_cost > 0
+                        and (obs.cost_by_tenant.get(t, 0.0) / total_cost
+                             > self.cost_share_cap)):
+                    # over the measured-cost cap: record the denial so
+                    # the decision stream explains the missing grant
+                    d.add("quota_denied", tenant=t, reason="cost_share",
+                          share=round(obs.cost_by_tenant[t] / total_cost,
+                                      6), cap=self.cost_share_cap)
+                    continue
                 cur = self._scale.get(t, 1.0)
                 new = min(self.max_scale, cur * self.step)
                 if new != cur:

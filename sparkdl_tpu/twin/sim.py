@@ -176,6 +176,8 @@ class TrafficTwin:
         twin_mesh = get_mesh(num_devices=1)
         assert len(twin_mesh.devices.flat) == 1, (
             "twin harness requires the single-device mesh pin")
+        from sparkdl_tpu.obs.cost import CostLedger
+
         fleet = Fleet(
             default_quota=self.default_quota,
             # the stream tenant is infrastructure, not a customer: no
@@ -183,6 +185,13 @@ class TrafficTwin:
             quotas={"stream": TenantQuota()},
             slos=[slo],
             cache=InferenceCache(metrics=metrics),
+            # measured cost attribution (ISSUE 18): the policy's
+            # cost_by_tenant axis reads this ledger's ROWS/FLOPS units
+            # (deterministic — integer rows x lockfile constants, never
+            # wall seconds), and max_tenants is sized past any twin day
+            # so top-K folding (ranked by wall-measured spend) can
+            # never perturb the byte-compared event stream
+            cost=CostLedger(max_tenants=max(256, c.tenants + 8)),
             metrics=metrics,
             clock=clock,
             max_batch_size=64,
@@ -409,7 +418,9 @@ class TrafficTwin:
             fault_drops=fault_drops, cache_hits=cache_hits,
             stream_commits=stream_commits,
             offered_tenant=offered_tenant,
-            completed_tenant=completed_tenant)
+            completed_tenant=completed_tenant,
+            cost_by_tenant=(fleet.cost.tenant_costs()
+                            if fleet.cost is not None else {}))
         final_varz = fleet.varz()
         flight_emit("twin.scenario", tick=c.ticks, phase="done",
                     vt=round(clock.now, 3),
@@ -484,7 +495,21 @@ class TrafficTwin:
             canary_fraction=(rollout.fraction
                              if rollout is not None and rollout.active
                              else 0.0),
-            flush_sizes=flush)
+            flush_sizes=flush,
+            cost_by_tenant=self._cost_units(varz))
+
+    @staticmethod
+    def _cost_units(varz: Dict[str, Any]) -> Dict[str, float]:
+        """Cumulative per-tenant MEASURED cost in deterministic units:
+        the ledger's attributed lockfile FLOPs where covered, attributed
+        rows otherwise.  Safe inside the byte-compared observation: the
+        tick barrier settles every charge first, and rows x analytic
+        constants carry no wall-clock component (unlike the ledger's
+        device_s axis, which stays out of here)."""
+        out: Dict[str, float] = {}
+        for t, v in ((varz.get("cost") or {}).get("tenants") or {}).items():
+            out[t] = (v["flops"] if v["flops"] > 0 else float(v["rows"]))
+        return out
 
     def _event_line(self, obs: TickObservation, varz: Dict[str, Any],
                     decision: PolicyDecision, phase: str) -> str:
@@ -504,6 +529,7 @@ class TrafficTwin:
             "cache_hits_coalesced_total": hits_coalesced,
             "canary": {"active": obs.canary_active,
                        "fraction": obs.canary_fraction},
+            "cost_by_tenant": obs.cost_by_tenant,
             "decision": decision.adjustments,
         }
         return json.dumps(doc, sort_keys=True, separators=(",", ":"))
@@ -516,7 +542,9 @@ class TrafficTwin:
     def _scores(self, *, breach_ticks: int, offered: int, submitted: int,
                 shed: int, fault_drops: int, cache_hits: int,
                 stream_commits: int, offered_tenant: np.ndarray,
-                completed_tenant: np.ndarray) -> Dict[str, Any]:
+                completed_tenant: np.ndarray,
+                cost_by_tenant: Optional[Dict[str, float]] = None
+                ) -> Dict[str, Any]:
         c = self.config
         active = offered_tenant > 0
         ratios = (completed_tenant[active]
@@ -525,7 +553,17 @@ class TrafficTwin:
         fairness = (float((ratios.sum() ** 2)
                           / (n * float((ratios ** 2).sum())))
                     if n and float((ratios ** 2).sum()) > 0 else 1.0)
+        # Jain over MEASURED cost units (ISSUE 18) across customer
+        # tenants — the request-count fairness above can read 1.0 while
+        # one tenant burns all the hardware; this axis can't
+        costs = np.asarray([v for t, v in sorted(
+            (cost_by_tenant or {}).items()) if t != "stream"],
+            dtype=np.float64)
+        sq = float((costs ** 2).sum())
+        cost_fairness = (float((costs.sum() ** 2) / (costs.size * sq))
+                         if costs.size and sq > 0 else 1.0)
         return {
+            "cost_fairness": round(cost_fairness, 6),
             "slo_minutes": round(breach_ticks * c.tick_s / 60.0, 3),
             "breach_ticks": breach_ticks,
             "goodput": (round((offered - shed) / offered, 6)

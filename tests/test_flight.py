@@ -745,3 +745,48 @@ def test_chaos_blackbox_reconstructs_causal_chain(tmp_path):
     causal2 = [(e["event"], (e.get("attrs") or {}).get("reason"))
                for e in doc2["events"] if e["event"] in _CAUSAL]
     assert causal1 == causal2
+
+
+# -- ISSUE 18: the cost sentinel on the blackbox timeline ------------------
+
+def test_cost_regression_rides_blackbox_timeline(tmp_path):
+    """The sentinel's ``cost.regression``/``cost.recovered`` events and
+    the health transitions they cause fold into the blackbox causal
+    chain like any other incident — and once recovered, the timeline's
+    verdict reads clean."""
+    from sparkdl_tpu.obs.cost import CostLedger
+    from tools.blackbox import build_timeline
+
+    bb_dir = str(tmp_path / "blackbox")
+    flight.configure(enabled=True, out_dir=bb_dir)
+    tracker = HealthTracker("serving.cost.health")
+    ledger = CostLedger(window=4, min_batches=4, regress_factor=2.0,
+                        recover_factor=1.5, health=tracker,
+                        lockfile_path="/nonexistent/lock.json")
+
+    def batch(device_s):
+        ledger.record_batch(model="m", bucket=8,
+                            tenant_rows={"a": 8}, device_s=device_s)
+
+    for _ in range(6):      # pin the baseline
+        batch(0.001)
+    for _ in range(4):      # sustained 12x slowdown -> open + degrade
+        batch(0.012)
+    for _ in range(4):      # recovery -> close + ready
+        batch(0.001)
+    flight.get_recorder().dump()
+
+    doc = build_timeline(bb_dir)
+    assert _is_subsequence(
+        ["cost.regression", "health.degraded", "cost.recovered",
+         "health.ready"], doc["chain"]), doc["chain"]
+    assert doc["counts"]["cost.regression"] == 1
+    assert doc["counts"]["cost.recovered"] == 1
+    assert doc["health"] == {"serving.cost.health": "ready"}
+    assert doc["verdict"]["clean"] is True, doc["verdict"]
+    ev = next(e for e in doc["events"]
+              if e["event"] == "cost.regression")
+    assert ev["attrs"]["program"] == "m/b8"
+    assert ev["attrs"]["factor"] >= 2.0
+    assert ev["attrs"]["reason"] == "baseline"
+    json.dumps(doc)

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""costreport — per-tenant / per-program hardware showback from a cost
+ledger snapshot (ISSUE 18).
+
+Usage::
+
+    python tools/costreport.py varz.json            # a varz() dump
+    python tools/costreport.py cost.json --json     # or a bare snapshot
+    python tools/costreport.py varz.json --tenant t7
+
+Accepts either a full ``varz()`` document (the ``cost`` section is
+extracted — ``Server``, ``HeadFanoutServer`` and ``Fleet`` dumps all
+work) or a bare ``CostLedger.snapshot()``.  Renders the per-tenant
+spend table (device seconds, rows, queue wait, analytic FLOPs, HBM
+byte-seconds, cache absorption), the per-program sentinel table
+(measured vs baseline device-time/row), the shared pad-tax line, and
+the conservation check (attributed == metered total).
+
+Exit codes: 0 — no open cost regression; 1 — at least one program's
+regression is OPEN (the sentinel's CI hook: a pipeline that dumps varz
+and runs costreport fails the build on a perf regression); 2 —
+unreadable/corrupt input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """The cost snapshot from ``path``: a bare ``snapshot()`` dict, or
+    any varz-shaped document carrying a ``cost`` section.  Returns None
+    when the document is valid JSON but cost attribution was off
+    (``"cost": null``)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("expected a JSON object")
+    if "totals" in doc and "tenants" in doc:
+        return doc
+    if "cost" in doc:
+        cost = doc["cost"]
+        if cost is not None and not (isinstance(cost, dict)
+                                     and "totals" in cost):
+            raise ValueError("malformed cost section")
+        return cost
+    raise ValueError("document carries neither a cost snapshot nor a "
+                     "varz 'cost' section")
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:.3f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def _fmt_big(v: float) -> str:
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if v >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def render(snap: Dict[str, Any], tenant: Optional[str] = None) -> None:
+    tot = snap["totals"]
+    print(f"batches {tot['batches']}  rows {tot['rows']} "
+          f"(+{tot['pad_rows']} pad)  device {_fmt_s(tot['device_s'])}  "
+          f"queue {_fmt_s(tot['queue_s'])}  attr-errors "
+          f"{tot['attr_errors']}")
+    dev = tot["device_s"]
+    attributed = tot["attributed_device_s"]
+    drift = abs(attributed - dev) / dev if dev else 0.0
+    print(f"conservation: attributed {_fmt_s(attributed)} vs metered "
+          f"{_fmt_s(dev)} (rel drift {drift:.2e})")
+    tenants = snap.get("tenants") or {}
+    if tenant is not None:
+        tenants = {t: v for t, v in tenants.items() if t == tenant}
+    if tenants:
+        print(f"{'tenant':<16}{'device':>12}{'share':>8}{'rows':>10}"
+              f"{'queue':>12}{'flops':>10}{'hbm-B.s':>10}{'hits':>6}")
+        total_dev = sum(v["device_s"] for v in tenants.values()) or 1.0
+        order = sorted(tenants,
+                       key=lambda t: (-tenants[t]["device_s"], t))
+        for t in order:
+            v = tenants[t]
+            hits = v["hits"] + v["coalesced"] + v["feature_hits"]
+            print(f"{t:<16}{_fmt_s(v['device_s']):>12}"
+                  f"{v['device_s'] / total_dev:>8.1%}{v['rows']:>10}"
+                  f"{_fmt_s(v['queue_s']):>12}"
+                  f"{_fmt_big(v['flops']):>10}"
+                  f"{_fmt_big(v['hbm_bytes_s']):>10}{hits:>6}")
+    pad = snap.get("pad") or {}
+    if pad:
+        print(f"{'__pad__ (shared)':<16}{_fmt_s(pad['device_s']):>12}"
+              f"{'':>8}{pad['rows']:>10}")
+    programs = snap.get("programs") or {}
+    if programs:
+        print(f"{'program':<44}{'us/row':>10}{'baseline':>10}"
+              f"{'state':>10}")
+        for name in sorted(programs):
+            p = programs[name]
+            m = p.get("measured_s_per_row")
+            b = p.get("baseline_s_per_row")
+            print(f"{name:<44}"
+                  f"{(f'{m * 1e6:.1f}' if m is not None else '-'):>10}"
+                  f"{(f'{b * 1e6:.1f}' if b is not None else '-'):>10}"
+                  f"{('REGRESSED' if p.get('regressed') else 'ok'):>10}")
+    sentinel = snap.get("sentinel") or {}
+    for name, rec in sorted((sentinel.get("open") or {}).items()):
+        print(f"OPEN regression: {name}  factor {rec.get('factor')}x "
+              f"({rec.get('reason')} check, opened at batch "
+              f"{rec.get('opened_batch')})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="costreport",
+        description="per-tenant / per-program hardware showback from a "
+                    "cost ledger snapshot (varz dump or bare snapshot)")
+    ap.add_argument("path", help="JSON file: varz() dump or "
+                                 "CostLedger.snapshot()")
+    ap.add_argument("--tenant", help="narrow the tenant table to one "
+                                     "tenant")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot (tenant-filtered) as JSON "
+                         "instead of tables")
+    args = ap.parse_args(argv)
+    try:
+        snap = load_snapshot(args.path)
+    # graftlint: allow=SDL003 reason=CLI exit-code surface: any unreadable/corrupt input becomes exit 2 with the error printed to stderr, never a stack trace
+    except Exception as e:
+        print(f"costreport: unreadable input: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if snap is None:
+        print("cost attribution was off for this dump "
+              "(varz cost section is null)")
+        return 0
+    if args.json:
+        doc = dict(snap)
+        if args.tenant is not None:
+            doc["tenants"] = {t: v for t, v in
+                              (snap.get("tenants") or {}).items()
+                              if t == args.tenant}
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        render(snap, tenant=args.tenant)
+    open_regressions = (snap.get("sentinel") or {}).get("open") or {}
+    return 1 if open_regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
